@@ -1,0 +1,123 @@
+"""Benchmark driver: PageRank throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: PageRank MTEPS/chip (edges traversed per second across the 10
+pull rounds, symmetrised edge count), on an RMAT-style power-law graph.
+
+Baseline derivation (BASELINE.md): the reference GPU backend runs
+PageRank on soc-LiveJournal1 (68.99M directed edges) in 24.65 ms on
+8× V100 (`Performance.md:94`), i.e. 68.99e6 * 10 rounds / 0.02465 s
+/ 8 chips ≈ 3500 MTEPS per chip.  vs_baseline = our MTEPS/chip / 3500.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+BASELINE_MTEPS_PER_CHIP = 3500.0
+SCALE = 20  # 2^20 vertices
+EDGE_FACTOR = 16
+
+
+def rmat_edges(scale: int, edge_factor: int, seed: int = 7):
+    """Vectorised RMAT (a=0.57,b=0.19,c=0.19,d=0.05)."""
+    n = 1 << scale
+    e = n * edge_factor
+    rng = np.random.default_rng(seed)
+    src = np.zeros(e, dtype=np.int64)
+    dst = np.zeros(e, dtype=np.int64)
+    a, b, c = 0.57, 0.19, 0.19
+    for bit in range(scale):
+        r = rng.random(e)
+        src_bit = r >= a + b
+        dst_bit = ((r >= a) & (r < a + b)) | (r >= a + b + c)
+        src = (src << 1) | src_bit
+        dst = (dst << 1) | dst_bit
+    return n, src, dst
+
+
+def main():
+    import jax
+
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.models import PageRank
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.id_parser import IdParser
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.idxer import SortedArrayIdxer
+    from libgrape_lite_tpu.vertex_map.partitioner import SegmentedPartitioner
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    n, src, dst = rmat_edges(SCALE, EDGE_FACTOR)
+    comm_spec = CommSpec(fnum=1)
+
+    # identity vertex map (oids are already dense 0..n-1)
+    class _IdentityPartitioner:
+        fnum = 1
+        type_name = "identity"
+
+        def get_fnum(self):
+            return 1
+
+        def get_partition_id(self, oids):
+            return np.zeros(len(oids), dtype=np.int64)
+
+    class _IdentityIdxer:
+        type_name = "identity"
+
+        def __init__(self, size):
+            self._n = size
+
+        def get_index(self, oids):
+            return np.asarray(oids, dtype=np.int64)
+
+        def get_oid(self, lids):
+            return np.asarray(lids, dtype=np.int64)
+
+        def size(self):
+            return self._n
+
+    vm = VertexMap(_IdentityPartitioner(), [_IdentityIdxer(n)], IdParser(1, n))
+    frag = ShardedEdgecutFragment.build(
+        comm_spec, vm, src, dst, None,
+        directed=False,
+        load_strategy=LoadStrategy.kBothOutIn,
+    )
+    e_sym = 2 * len(src)  # undirected pull touches each edge twice per round
+
+    rounds = 10
+    app = PageRank(delta=0.85, max_round=rounds)
+    worker = Worker(app, frag)
+
+    # warmup (compile)
+    worker.query(max_round=rounds)
+    # timed
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        worker.query(max_round=rounds)
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+
+    mteps = e_sym * rounds / best / 1e6
+    print(
+        json.dumps(
+            {
+                "metric": f"pagerank_rmat{SCALE}_mteps_per_chip",
+                "value": round(mteps, 1),
+                "unit": "MTEPS/chip",
+                "vs_baseline": round(mteps / BASELINE_MTEPS_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

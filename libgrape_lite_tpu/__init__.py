@@ -1,0 +1,59 @@
+"""libgrape_lite_tpu — a TPU-native distributed graph-analytics framework.
+
+A from-scratch re-design of the capabilities of `alibaba/libgrape-lite`
+(the PIE model: PEval / IncEval / Assemble over partitioned graph
+fragments) for TPU hardware:
+
+* compute is expressed as dense / segment-reduce JAX ops (and Pallas
+  kernels for the hot paths) that XLA can tile onto the MXU/VPU,
+* fragments are padded, statically-shaped CSR shards living in HBM,
+* cross-fragment messaging lowers to XLA collectives (`all_gather`,
+  `psum`, `all_to_all`, `ppermute`) over the ICI mesh instead of
+  MPI/NCCL point-to-point traffic,
+* the superstep loop (reference `grape/worker/worker.h:104-146`) is a
+  jitted `lax.while_loop` with a `psum` termination vote replacing the
+  reference's 2-int `MPI_Allreduce`
+  (`grape/parallel/parallel_message_manager.h:123-138`).
+
+Layer map (mirrors SURVEY.md §1):
+
+    models/      the LDBC analytical apps (SSSP, BFS, WCC, PageRank,
+                 CDLP, LCC, ...) — reference `examples/analytical_apps`
+    app/         app base classes + contexts — reference `grape/app`
+    worker/      superstep drivers — reference `grape/worker`
+    parallel/    message managers (collective strategies), engine,
+                 communicator — reference `grape/parallel`,
+                 `grape/communication`
+    fragment/    fragment shards, loaders — reference `grape/fragment`
+    graph/       CSR storage — reference `grape/graph`
+    vertex_map/  oid⇄gid directory, partitioners, idxers — reference
+                 `grape/vertex_map`
+    ops/         TPU compute primitives + Pallas kernels — reference
+                 `grape/cuda` (the accelerator backend)
+    io/          TSV/graph IO — reference `grape/io`
+    utils/       substrate — reference `grape/utils`
+"""
+
+from libgrape_lite_tpu.version import __version__
+
+from libgrape_lite_tpu.utils.types import (
+    EmptyType,
+    LoadStrategy,
+    MessageStrategy,
+)
+from libgrape_lite_tpu.utils.id_parser import IdParser
+from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+from libgrape_lite_tpu.fragment.loader import LoadGraph, LoadGraphSpec
+from libgrape_lite_tpu.worker.worker import Worker
+
+__all__ = [
+    "__version__",
+    "EmptyType",
+    "LoadStrategy",
+    "MessageStrategy",
+    "IdParser",
+    "CommSpec",
+    "LoadGraph",
+    "LoadGraphSpec",
+    "Worker",
+]

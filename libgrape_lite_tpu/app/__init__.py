@@ -1,0 +1,9 @@
+from libgrape_lite_tpu.app.base import (
+    AppBase,
+    ParallelAppBase,
+    BatchShuffleAppBase,
+    AutoAppBase,
+    GatherScatterAppBase,
+    ContextBase,
+    VertexDataContext,
+)

@@ -1,0 +1,150 @@
+"""App-framework API: the TPU PIE model.
+
+Re-design of `grape/app/*`:
+  * `ParallelAppBase` (`parallel_app_base.h:38-109`) — PEval/IncEval +
+    static traits,
+  * `AutoAppBase` (`auto_app_base.h:38-84`) — implicit messaging,
+  * `BatchShuffleAppBase` (`batch_shuffle_app_base.h`) — whole-array sync,
+  * `GatherScatterAppBase` (`gather_scatter_app_base.h:30-61`) —
+    vertex-cut apps,
+  * `ContextBase` / `VertexDataContext` (`context_base.h`,
+    `vertex_data_context.h:24-80`).
+
+The TPU contract: an app provides
+
+  * `init_state(frag, **query_args)` — host-side: build the initial
+    per-fragment state (numpy arrays stacked `[fnum, ...]`; leaves named
+    in `replicated_keys` are mesh-replicated scalars/arrays).  This is
+    the host half of PEval (e.g. placing the source distance).
+  * `peval(ctx, frag, state) -> (state, active)` — traced per shard
+    (inside `shard_map`); first superstep.
+  * `inceval(ctx, frag, state) -> (state, active)` — traced per shard;
+    repeated until the `psum`-reduced `active` vote is zero (the
+    reference's termination allreduce,
+    `parallel_message_manager.h:123-138`) or `max_rounds` is hit.
+  * `finalize(frag, state) -> np.ndarray [fnum, vp]` — host-side
+    assemble: per-vertex output values.
+
+`ctx` is the `Communicator` namespace (psum/pmin/pmax/all_gather/
+ppermute) plus the gather helper; messaging *is* collectives — there is
+no buffer/archive machinery to port because XLA owns the transport.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from libgrape_lite_tpu.fragment.edgecut import DeviceFragment
+from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
+from libgrape_lite_tpu.parallel.communicator import Communicator
+from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
+
+
+class StepContext(Communicator):
+    """Per-superstep toolkit handed to app code while tracing."""
+
+    @staticmethod
+    def gather_state(x_local):
+        """Local per-vertex block [vp, ...] -> full pid-indexed array
+        [fnum * vp, ...].  The TPU form of BatchShuffle's
+        `SyncInnerVertices` + `UpdateOuterVertices`
+        (`batch_shuffle_message_manager.h:237,264`): one `all_gather`
+        over ICI replaces per-neighbor mirror buffers."""
+        return lax.all_gather(x_local, FRAG_AXIS, tiled=True)
+
+    @staticmethod
+    def fid():
+        return lax.axis_index(FRAG_AXIS)
+
+
+class ContextBase:
+    """Per-query mutable state descriptor (reference `context_base.h`).
+    In the TPU build context state *is* the state pytree; this class only
+    carries metadata used by the driver."""
+
+
+class VertexDataContext(ContextBase):
+    """Marker for apps whose result is one value per vertex
+    (reference `vertex_data_context.h:24-80`)."""
+
+
+class AppBase:
+    # trait parity (parallel_app_base.h:42-46)
+    load_strategy: LoadStrategy = LoadStrategy.kBothOutIn
+    message_strategy: MessageStrategy = MessageStrategy.kSyncOnOuterVertex
+    need_split_edges: bool = False
+
+    # state keys that are mesh-replicated (everything else is sharded
+    # with leading fragment dim)
+    replicated_keys: FrozenSet[str] = frozenset()
+
+    # 0 means "run until the termination vote fires"
+    max_rounds: int = 0
+
+    # output formatting
+    result_format: str = "float"  # float | int | sssp_infinity
+
+    def init_state(self, frag, **query_args) -> Dict:
+        raise NotImplementedError
+
+    def peval(self, ctx: StepContext, frag: DeviceFragment, state: Dict):
+        raise NotImplementedError
+
+    def inceval(self, ctx: StepContext, frag: DeviceFragment, state: Dict):
+        raise NotImplementedError
+
+    def finalize(self, frag, state: Dict):
+        raise NotImplementedError
+
+    def trace_key(self):
+        """Hashable fingerprint of every hyperparameter that gets baked
+        into the traced superstep (used to key the compiled-runner
+        cache).  Default: all primitive instance attributes."""
+        items = []
+        for k, v in sorted(self.__dict__.items()):
+            if isinstance(v, (int, float, str, bool, type(None), np.dtype)):
+                items.append((k, v))
+        return tuple(items)
+
+    # ---- shared compute helpers ----
+
+    @staticmethod
+    def segment_reduce(values, edge_src, vp, kind="sum"):
+        """Reduce per-edge values into per-vertex rows; padded edges fall
+        into the overflow row `vp` which is sliced off.  This is the TPU
+        ForEachEdge: edge-parallel, degree-oblivious (the role of the
+        reference CUDA LB kernels, `cuda/parallel/parallel_engine.h`)."""
+        from libgrape_lite_tpu.ops.segment import segment_reduce
+
+        return segment_reduce(values, edge_src, vp, kind)
+
+
+class ParallelAppBase(AppBase):
+    """Explicit-messaging superstep app (reference ParallelAppBase)."""
+
+
+class BatchShuffleAppBase(AppBase):
+    """Whole-array mirror-sync app (PageRank-style)."""
+
+    message_strategy = MessageStrategy.kSyncOnOuterVertex
+
+
+class AutoAppBase(AppBase):
+    """Auto-messaging app: state sync is implied by declared SyncBuffers
+    (reference `auto_app_base.h`, `auto_parallel_message_manager.h:47-365`).
+    In the TPU build `sync_buffers` maps state-key -> aggregate kind
+    ('min'|'max'|'sum'); the driver gathers and aggregates automatically,
+    so subclasses only write the local compute in `compute(ctx, frag,
+    state, gathered)`."""
+
+    sync_buffers: Dict[str, str] = {}
+
+
+class GatherScatterAppBase(AppBase):
+    """Vertex-cut app (reference `gather_scatter_app_base.h:30-61`)."""
+
+    message_strategy = MessageStrategy.kGatherScatter

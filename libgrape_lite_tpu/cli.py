@@ -1,0 +1,65 @@
+"""Command-line driver: `python -m libgrape_lite_tpu.cli --application sssp ...`
+
+Flag names mirror the reference gflags catalog
+(`examples/analytical_apps/flags.cc:23-69`).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from libgrape_lite_tpu.runner import QueryArgs, run_app
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="libgrape_lite_tpu")
+    p.add_argument("--application", required=True)
+    p.add_argument("--efile", required=True)
+    p.add_argument("--vfile", default="")
+    p.add_argument("--out_prefix", default="")
+    p.add_argument("--directed", action="store_true")
+    p.add_argument("--sssp_source", type=int, default=0)
+    p.add_argument("--bfs_source", type=int, default=0)
+    p.add_argument("--pr_d", type=float, default=0.85)
+    p.add_argument("--pr_mr", type=int, default=10)
+    p.add_argument("--cdlp_mr", type=int, default=10)
+    p.add_argument("--fnum", type=int, default=None,
+                   help="fragment count (default: all local devices)")
+    p.add_argument("--partitioner_type", default="map",
+                   choices=["hash", "map", "segment"])
+    p.add_argument("--idxer_type", default="hashmap",
+                   choices=["hashmap", "sorted_array", "pthash", "local"])
+    p.add_argument("--serialize", action="store_true")
+    p.add_argument("--deserialize", action="store_true")
+    p.add_argument("--serialization_prefix", default="")
+    p.add_argument("--platform", default="",
+                   help="jax platform override (e.g. cpu); default ambient")
+    p.add_argument("--cpu_devices", type=int, default=0,
+                   help="with --platform cpu: virtual device count")
+    return p
+
+
+def main(argv=None):
+    ns = make_parser().parse_args(argv)
+    platform = ns.platform
+    cpu_devices = ns.cpu_devices
+    if cpu_devices:
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={cpu_devices}"
+        ).strip()
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    args = QueryArgs(
+        **{k: v for k, v in vars(ns).items()
+           if k not in ("platform", "cpu_devices")}
+    )
+    run_app(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+from libgrape_lite_tpu.fragment.edgecut import (
+    DeviceCSR,
+    DeviceFragment,
+    ShardedEdgecutFragment,
+)
+from libgrape_lite_tpu.fragment.loader import LoadGraph, LoadGraphSpec

@@ -1,0 +1,1 @@
+from libgrape_lite_tpu.graph.csr import CSR, build_csr

@@ -1,0 +1,80 @@
+"""Padded CSR storage.
+
+Re-design of `grape/graph/immutable_csr.h:36-381` for XLA: a CSR here is
+a *statically shaped* struct of arrays.  On top of the classic
+`indptr` we keep the expanded per-edge source row (`edge_src`) so that
+per-edge compute lowers to gather + `segment_sum/min/max` — the TPU
+analogue of the reference CUDA engine's edge-balanced load-balancing
+kernels (`grape/cuda/parallel/parallel_engine.h:621-1100`): work is
+partitioned over *edges*, never over variable-degree vertex loops.
+
+Padding contract:
+  * vertex rows are padded to `num_rows` (power of two);
+  * edges are padded to `num_edges_padded`; padded edges have
+    `edge_src = num_rows` (an overflow segment sliced off by consumers),
+    `edge_nbr = 0` and `edge_mask = False`.
+
+Adjacency is sorted by (src, nbr) — the reference sorts neighbor lists
+too (`immutable_csr.h:46-120`), which gives deterministic reductions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class CSR:
+    """Host-side (numpy) padded CSR for one fragment."""
+
+    indptr: np.ndarray  # [num_rows + 1] int32
+    edge_src: np.ndarray  # [Ep] int32, local row id; pad = num_rows
+    edge_nbr: np.ndarray  # [Ep] int64/int32, neighbor *global padded id*
+    edge_w: np.ndarray | None  # [Ep] float, 0-padded
+    edge_mask: np.ndarray  # [Ep] bool
+    num_rows: int
+    num_edges: int  # real edge count
+
+    @property
+    def degree(self) -> np.ndarray:
+        return np.diff(self.indptr)
+
+
+def build_csr(
+    src_lid: np.ndarray,
+    nbr_pid: np.ndarray,
+    weights: np.ndarray | None,
+    num_rows: int,
+    num_edges_padded: int,
+    nbr_dtype=np.int32,
+) -> CSR:
+    """Two-pass build (degree count then fill), like the reference's
+    parallel builder (`immutable_csr.h:46-120`) but vectorised."""
+    e = len(src_lid)
+    if e > num_edges_padded:
+        raise ValueError(f"edge overflow: {e} > {num_edges_padded}")
+    order = np.lexsort((nbr_pid, src_lid))
+    src_sorted = np.asarray(src_lid)[order].astype(np.int32)
+    nbr_sorted = np.asarray(nbr_pid)[order].astype(nbr_dtype)
+    w_sorted = None if weights is None else np.asarray(weights)[order]
+
+    counts = np.bincount(src_sorted, minlength=num_rows)
+    indptr = np.zeros(num_rows + 1, dtype=np.int32)
+    np.cumsum(counts, out=indptr[1:])
+
+    pad = num_edges_padded - e
+    edge_src = np.concatenate(
+        [src_sorted, np.full(pad, num_rows, dtype=np.int32)]
+    )
+    edge_nbr = np.concatenate([nbr_sorted, np.zeros(pad, dtype=nbr_dtype)])
+    edge_w = (
+        None
+        if w_sorted is None
+        else np.concatenate([w_sorted, np.zeros(pad, dtype=w_sorted.dtype)])
+    )
+    edge_mask = np.concatenate(
+        [np.ones(e, dtype=bool), np.zeros(pad, dtype=bool)]
+    )
+    return CSR(indptr, edge_src, edge_nbr, edge_w, edge_mask, num_rows, e)

@@ -1,0 +1,2 @@
+from libgrape_lite_tpu.io.io_adaptor import LocalIOAdaptor
+from libgrape_lite_tpu.io.line_parser import TSVLineParser, read_edge_file, read_vertex_file

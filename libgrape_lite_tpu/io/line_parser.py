@@ -1,0 +1,84 @@
+"""TSV line parsing for LDBC .v/.e files.
+
+Re-design of `grape/io/line_parser_base.h` + `tsv_line_parser.h`: instead
+of a virtual per-line parser driven by each MPI rank, the host parses
+whole byte ranges columnarly (pandas C engine when available, numpy
+fallback) — orders of magnitude faster in Python and the natural feed for
+building padded device tensors.
+"""
+
+from __future__ import annotations
+
+import io as _io
+
+import numpy as np
+
+try:
+    import pandas as _pd
+except Exception:  # pragma: no cover
+    _pd = None
+
+
+class TSVLineParser:
+    """Parses whitespace-separated `src dst [edata]` / `oid [vdata]` lines."""
+
+    def parse_edges(self, data: bytes, has_edata: bool):
+        return _parse_columns(data, 2, 3 if has_edata else 2)
+
+    def parse_vertices(self, data: bytes):
+        return _parse_columns(data, 1, 1)
+
+
+def _parse_columns(data: bytes, int_cols: int, want_cols: int):
+    """Parse whitespace table; the first `int_cols` columns keep full
+    int64 precision (oids above 2^53 must not round-trip through
+    float64 — the reference parses oids as integers,
+    `tsv_line_parser.h`)."""
+    if _pd is not None:
+        df = _pd.read_csv(
+            _io.BytesIO(data),
+            sep=r"\s+",
+            header=None,
+            comment="#",
+            engine="c",
+        )
+        cols = []
+        for i in range(min(want_cols, df.shape[1])):
+            c = df.iloc[:, i].to_numpy()
+            cols.append(
+                c.astype(np.int64) if i < int_cols else c.astype(np.float64)
+            )
+        return cols
+    # numpy fallback: two passes to keep id precision
+    ids = np.loadtxt(
+        _io.BytesIO(data), dtype=np.int64, comments="#", ndmin=2,
+        usecols=range(int_cols),
+    )
+    cols = [ids[:, i] for i in range(int_cols)]
+    if want_cols > int_cols:
+        try:
+            extra = np.loadtxt(
+                _io.BytesIO(data), dtype=np.float64, comments="#", ndmin=2,
+                usecols=range(int_cols, want_cols),
+            )
+            cols.extend(extra[:, i] for i in range(extra.shape[1]))
+        except (ValueError, IndexError):
+            pass
+    return cols
+
+
+def read_vertex_file(path: str) -> np.ndarray:
+    """Read a .v file; returns int64 oids (first column)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return _parse_columns(data, 1, 1)[0]
+
+
+def read_edge_file(path: str, weighted: bool):
+    """Read a .e file; returns (src_oid, dst_oid, weight|None)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    cols = _parse_columns(data, 2, 3 if weighted else 2)
+    src, dst = cols[0], cols[1]
+    w = cols[2] if (weighted and len(cols) > 2) else None
+    return src, dst, w

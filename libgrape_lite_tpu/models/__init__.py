@@ -1,0 +1,21 @@
+"""The analytical-app library (reference `examples/analytical_apps`).
+
+Each app is a PIE program: host-side `init_state` (PEval's setup),
+traced `peval`/`inceval` supersteps, host-side `finalize` (Assemble).
+"""
+
+from libgrape_lite_tpu.models.pagerank import PageRank
+from libgrape_lite_tpu.models.sssp import SSSP
+from libgrape_lite_tpu.models.bfs import BFS
+from libgrape_lite_tpu.models.wcc import WCC
+from libgrape_lite_tpu.models.cdlp import CDLP
+from libgrape_lite_tpu.models.lcc import LCC
+
+APP_REGISTRY = {
+    "pagerank": PageRank,
+    "sssp": SSSP,
+    "bfs": BFS,
+    "wcc": WCC,
+    "cdlp": CDLP,
+    "lcc": LCC,
+}

@@ -1,0 +1,56 @@
+"""BFS — breadth-first search levels.
+
+Re-design of `examples/analytical_apps/bfs/bfs.h:30-150` (level-sync
+frontier bitmaps).  TPU formulation: pull-mode unit-weight Bellman-Ford
+over int32 depths — identical level assignment, no frontier compaction
+needed (masked dense relaxation; XLA keeps it on the VPU).  Unreached
+vertices keep the int sentinel and print as the reference's
+`std::numeric_limits<int64_t>::max()` (`bfs_context.h:44`, golden
+`p2p-31-BFS`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from libgrape_lite_tpu.app.base import ParallelAppBase, StepContext
+from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
+
+_SENTINEL = np.iinfo(np.int32).max
+_OUT_SENTINEL = np.iinfo(np.int64).max  # printed for unreachable
+
+
+class BFS(ParallelAppBase):
+    load_strategy = LoadStrategy.kBothOutIn
+    message_strategy = MessageStrategy.kSyncOnOuterVertex
+    result_format = "int"
+
+    def init_state(self, frag, source=0):
+        depth = np.full((frag.fnum, frag.vp), _SENTINEL, dtype=np.int32)
+        pid = frag.oid_to_pid(np.array([source]))[0]
+        if pid >= 0:
+            depth[pid // frag.vp, pid % frag.vp] = 0
+        return {"depth": depth}
+
+    def peval(self, ctx: StepContext, frag, state):
+        return state, jnp.int32(1)
+
+    def inceval(self, ctx: StepContext, frag, state):
+        depth = state["depth"]
+        ie = frag.ie
+        full = ctx.gather_state(depth)
+        nbr_d = full[ie.edge_nbr]
+        sent = jnp.int32(_SENTINEL)
+        cand = jnp.where(
+            jnp.logical_and(ie.edge_mask, nbr_d != sent), nbr_d + 1, sent
+        )
+        relaxed = self.segment_reduce(cand, ie.edge_src, frag.vp, "min")
+        new = jnp.minimum(depth, relaxed)
+        changed = jnp.logical_and(new < depth, frag.inner_mask)
+        active = ctx.sum(changed.sum().astype(jnp.int32))
+        return {"depth": new}, active
+
+    def finalize(self, frag, state):
+        d = np.asarray(state["depth"]).astype(np.int64)
+        return np.where(d == _SENTINEL, _OUT_SENTINEL, d)

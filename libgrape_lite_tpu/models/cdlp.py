@@ -1,0 +1,100 @@
+"""CDLP — community detection by synchronous label propagation.
+
+Re-design of `examples/analytical_apps/cdlp/cdlp.h` +
+`cdlp_utils.h::update_label_fast`: labels start as vertex ids; each of
+`max_round` rounds every vertex adopts the most frequent label among its
+out-neighbors (previous-round values), ties broken toward the smallest
+label (the reference sorts labels ascending and keeps the first strict
+maximum).
+
+TPU formulation of the mode computation — sort-free-loop, all segment
+ops (no per-vertex hash map):
+
+  1. gather labels, read one per edge,
+  2. sort edge (src, label) pairs (`jnp.lexsort`),
+  3. run-length encode equal (src,label) runs via boundary cumsum,
+  4. per-edge run length -> per-src max run length (`segment_max`),
+  5. among runs achieving the max, take the smallest label
+     (`segment_min` over masked labels).
+
+Everything is O(E log E) on device with static shapes; multi-edges
+contribute multiplicity exactly like the reference's neighbor scan.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from libgrape_lite_tpu.app.base import ParallelAppBase, StepContext
+from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
+
+_BIG = np.iinfo(np.int64).max
+
+
+class CDLP(ParallelAppBase):
+    load_strategy = LoadStrategy.kOnlyOut
+    message_strategy = MessageStrategy.kAlongOutgoingEdgeToOuterVertex
+    result_format = "int"
+    replicated_keys = frozenset({"step"})
+
+    def __init__(self, max_round: int = 10, label_dtype=np.int64):
+        self.max_round = max_round
+        self.label_dtype = label_dtype
+
+    def init_state(self, frag, max_round: int | None = None):
+        if max_round is not None:
+            self.max_round = max_round
+        oids = np.asarray(frag.dev.oids).astype(self.label_dtype)
+        labels = np.where(oids >= 0, oids, _BIG)
+        return {"labels": labels, "step": np.int32(0)}
+
+    def _propagate(self, ctx, frag, labels):
+        oe = frag.oe
+        vp = frag.vp
+        dt = labels.dtype
+        big = jnp.asarray(np.iinfo(np.dtype(dt).name).max, dt)
+
+        full = ctx.gather_state(labels)
+        lab = jnp.where(oe.edge_mask, full[oe.edge_nbr], big)
+        src = jnp.where(oe.edge_mask, oe.edge_src, jnp.int32(vp))
+
+        order = jnp.lexsort((lab, src))
+        ss = src[order]
+        ll = lab[order]
+        valid = ss != jnp.int32(vp)
+
+        first = jnp.ones_like(ss, dtype=bool).at[1:].set(
+            jnp.logical_or(ss[1:] != ss[:-1], ll[1:] != ll[:-1])
+        )
+        run_id = jnp.cumsum(first.astype(jnp.int32)) - 1
+        e = ss.shape[0]
+        run_len = self.segment_reduce(
+            valid.astype(jnp.int32), run_id, e - 1, "sum"
+        )  # runs <= E
+        c_e = run_len[run_id]
+
+        cmax = self.segment_reduce(c_e, ss, vp, "max")
+        is_best = jnp.logical_and(valid, c_e == cmax[jnp.minimum(ss, vp - 1)])
+        cand = jnp.where(is_best, ll, big)
+        new_lab = self.segment_reduce(cand, ss, vp, "min")
+
+        has_out = frag.out_degree > 0
+        keep = jnp.logical_or(~frag.inner_mask, ~has_out)
+        return jnp.where(jnp.logical_or(keep, new_lab == big), labels, new_lab)
+
+    def peval(self, ctx: StepContext, frag, state):
+        # reference PEval: step=1, one propagation (cdlp.h PEval)
+        labels = self._propagate(ctx, frag, state["labels"])
+        state = dict(labels=labels, step=jnp.int32(1))
+        active = jnp.int32(1 if self.max_round > 1 else 0)
+        return state, active
+
+    def inceval(self, ctx: StepContext, frag, state):
+        step = state["step"] + 1
+        labels = self._propagate(ctx, frag, state["labels"])
+        active = jnp.where(step >= jnp.int32(self.max_round), jnp.int32(0), jnp.int32(1))
+        return dict(labels=labels, step=step), active
+
+    def finalize(self, frag, state):
+        return np.asarray(state["labels"])

@@ -1,0 +1,220 @@
+"""LCC — local clustering coefficient (triangle counting).
+
+Re-design of `examples/analytical_apps/lcc/lcc.h` (+ the SIMD set
+intersection of `lcc_opt.h:26-41`): orient the (deduplicated) undirected
+graph into a DAG by (degree, id) — u ∈ N+(v) iff deg(u) < deg(v) or
+(deg equal and id(u) < id(v)) (`lcc.h` stage-1 neighbor filter) — then
+every triangle has a unique apex v with v→u, v→w, u→w and each corner
+earns +1 (`lcc.h:170-180`).  lcc(v) = 2·T(v) / (deg(v)·(deg(v)−1)) with
+deg the raw adjacency degree (`lcc_context.h:52-68`).
+
+TPU formulation (validated bit-exact vs `dataset/p2p-31-LCC`):
+
+  * N+ / N− adjacency become *packed bitmaps* `[vp, N_pad/32] uint32`;
+    set intersection = `bitwise_and` + `lax.population_count` — the VPU
+    replaces the reference's STTNI/AVX-512 intersection kernels.
+  * Remote bitmap rows travel by ring `ppermute` (the classic systolic
+    distributed-join): at step s each shard holds shard (fid+s)'s N+
+    block and processes exactly the edges whose head lives there.  This
+    replaces the reference's per-vertex neighbor-list messages
+    (`lcc.h` stage 1→2) with dense ICI traffic.
+  * Per-corner credits: apex and middle credit locally per edge
+    (v, u ∈ edge), the far-end credit accumulates into a pid-indexed
+    vector folded by `psum` at the end.
+
+Three popcount passes per edge total — O(E · N/32) word-ops, chunked to
+bound HBM working set.  (A merge-path Pallas kernel for huge graphs is
+the planned successor; this dense form already beats list-intersection
+on TPU for LDBC-scale test graphs.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from libgrape_lite_tpu.app.base import ParallelAppBase, StepContext
+from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
+from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
+
+_CHUNK = 4096
+
+
+class LCC(ParallelAppBase):
+    load_strategy = LoadStrategy.kOnlyOut
+    message_strategy = MessageStrategy.kAlongOutgoingEdgeToOuterVertex
+    result_format = "float"
+    replicated_keys = frozenset()
+
+    def init_state(self, frag, **_):
+        return {
+            "lcc": np.zeros((frag.fnum, frag.vp), dtype=np.float64),
+        }
+
+    # ---- helpers -------------------------------------------------------
+
+    @staticmethod
+    def _dedup_mask(csr):
+        """Adjacent-duplicate mask; build_csr sorts by (src, nbr) so
+        multi-edges are adjacent."""
+        s, n = csr.edge_src, csr.edge_nbr
+        dup = jnp.zeros_like(csr.edge_mask).at[1:].set(
+            jnp.logical_and(s[1:] == s[:-1], n[1:] == n[:-1])
+        )
+        return jnp.logical_and(csr.edge_mask, ~dup)
+
+    @staticmethod
+    def _build_bitmap(rows, cols, keep, vp, words):
+        """Packed scatter: bit `cols[i]` of row `rows[i]` for kept edges.
+        Kept (row, col) pairs are unique, so bit-add == bit-or."""
+        r = jnp.where(keep, rows, jnp.int32(vp))  # trash row
+        word = (cols >> 5).astype(jnp.int32)
+        bit = jnp.uint32(1) << (cols & 31).astype(jnp.uint32)
+        bm = jnp.zeros((vp + 1, words), dtype=jnp.uint32)
+        bm = bm.at[r, word].add(jnp.where(keep, bit, jnp.uint32(0)))
+        return bm[:vp]
+
+    # ---- the staged computation ---------------------------------------
+
+    def peval(self, ctx: StepContext, frag, state):
+        vp, fnum = frag.vp, frag.fnum
+        n_pad = vp * fnum
+        words = (n_pad + 31) // 32
+        my_fid = lax.axis_index(FRAG_AXIS).astype(jnp.int32)
+        base_pid = my_fid * vp
+
+        deg_local = frag.out_degree  # includes multiplicity (lcc_context degree)
+        deg_full = ctx.gather_state(deg_local)
+
+        oe, ie = frag.oe, frag.ie
+
+        def oriented(csr, toward_nbr: bool):
+            """toward_nbr=True keeps edges oriented row→nbr
+            (deg[nbr] < deg[row] or tie with nbr_pid < row_pid);
+            False keeps nbr→row."""
+            row_pid = base_pid + jnp.minimum(csr.edge_src, vp - 1)
+            d_row = deg_local[jnp.minimum(csr.edge_src, vp - 1)]
+            d_nbr = deg_full[csr.edge_nbr]
+            if toward_nbr:
+                k = jnp.logical_or(
+                    d_nbr < d_row,
+                    jnp.logical_and(d_nbr == d_row, csr.edge_nbr < row_pid),
+                )
+            else:
+                k = jnp.logical_or(
+                    d_row < d_nbr,
+                    jnp.logical_and(d_nbr == d_row, row_pid < csr.edge_nbr),
+                )
+            return jnp.logical_and(self._dedup_mask(csr), k)
+
+        keep_oe = oriented(oe, True)   # v(row) → u(nbr):  u ∈ N+(v)
+        keep_ie = oriented(ie, False)  # u(nbr) → w(row):  u ∈ N−(w)
+
+        bplus = self._build_bitmap(oe.edge_src, oe.edge_nbr, keep_oe, vp, words)
+        bminus = self._build_bitmap(ie.edge_src, ie.edge_nbr, keep_ie, vp, words)
+
+        ep_oe = oe.edge_src.shape[0]
+        ep_ie = ie.edge_src.shape[0]
+        c_oe = min(_CHUNK, ep_oe)
+        c_ie = min(_CHUNK, ep_ie)
+        tri = jnp.zeros((vp,), dtype=jnp.int32)
+        cred = jnp.zeros((n_pad,), dtype=jnp.int32)
+
+        nbr_fid_oe = (oe.edge_nbr // vp).astype(jnp.int32)
+        nbr_lid_oe = (oe.edge_nbr % vp).astype(jnp.int32)
+        nbr_fid_ie = (ie.edge_nbr // vp).astype(jnp.int32)
+        nbr_lid_ie = (ie.edge_nbr % vp).astype(jnp.int32)
+
+        def edge_chunks(ep, c):
+            return max(1, -(-ep // c))
+
+        def intersect_pass(carry_tri, carry_cred, brot, cur_fid):
+            """One ring step: process oe edges (apex+middle credits) and
+            ie edges (far-end credit) whose nbr lives on `cur_fid`."""
+
+            def oe_body(i, acc):
+                t, c = acc
+                start = jnp.minimum(i * c_oe, ep_oe - c_oe)
+                pos = start + jnp.arange(c_oe, dtype=jnp.int32)
+                fresh = pos >= i * c_oe  # exclude clamped overlap
+                srcs = lax.dynamic_slice(oe.edge_src, (start,), (c_oe,))
+                nfid = lax.dynamic_slice(nbr_fid_oe, (start,), (c_oe,))
+                nlid = lax.dynamic_slice(nbr_lid_oe, (start,), (c_oe,))
+                kept = lax.dynamic_slice(keep_oe, (start,), (c_oe,))
+                sel = jnp.logical_and(jnp.logical_and(kept, fresh), nfid == cur_fid)
+                rows_v = bplus[jnp.minimum(srcs, vp - 1)]
+                rows_u = brot[nlid]
+                cnt = lax.population_count(rows_v & rows_u).sum(
+                    axis=1, dtype=jnp.int32
+                )
+                cnt = jnp.where(sel, cnt, 0)
+                t = t.at[jnp.where(sel, srcs, vp - 1)].add(
+                    jnp.where(sel, cnt, 0)
+                )
+                u_pid = cur_fid * vp + nlid
+                c = c.at[jnp.where(sel, u_pid, 0)].add(jnp.where(sel, cnt, 0))
+                return t, c
+
+            def ie_body(i, t):
+                start = jnp.minimum(i * c_ie, ep_ie - c_ie)
+                pos = start + jnp.arange(c_ie, dtype=jnp.int32)
+                fresh = pos >= i * c_ie
+                srcs = lax.dynamic_slice(ie.edge_src, (start,), (c_ie,))
+                nfid = lax.dynamic_slice(nbr_fid_ie, (start,), (c_ie,))
+                nlid = lax.dynamic_slice(nbr_lid_ie, (start,), (c_ie,))
+                kept = lax.dynamic_slice(keep_ie, (start,), (c_ie,))
+                sel = jnp.logical_and(jnp.logical_and(kept, fresh), nfid == cur_fid)
+                rows_w = bminus[jnp.minimum(srcs, vp - 1)]
+                rows_v = brot[nlid]
+                cnt = lax.population_count(rows_w & rows_v).sum(
+                    axis=1, dtype=jnp.int32
+                )
+                t = t.at[jnp.where(sel, srcs, vp - 1)].add(
+                    jnp.where(sel, cnt, 0)
+                )
+                return t
+
+            t = lax.fori_loop(
+                0, edge_chunks(ep_oe, c_oe), oe_body, (carry_tri, carry_cred)
+            )
+            carry_tri, carry_cred = t
+            carry_tri = lax.fori_loop(
+                0, edge_chunks(ep_ie, c_ie), ie_body, carry_tri
+            )
+            return carry_tri, carry_cred
+
+        if fnum == 1:
+            tri, cred = intersect_pass(tri, cred, bplus, jnp.int32(0))
+        else:
+            perm = [(i, (i - 1) % fnum) for i in range(fnum)]  # shift left
+
+            def ring_body(s, carry):
+                t, c, brot = carry
+                cur_fid = (my_fid + s) % fnum
+                t, c = intersect_pass(t, c, brot, cur_fid)
+                brot = lax.ppermute(brot, FRAG_AXIS, perm)
+                return t, c, brot
+
+            tri, cred, _ = lax.fori_loop(0, fnum, ring_body, (tri, cred, bplus))
+
+        cred_all = ctx.sum(cred)
+        tri = tri + lax.dynamic_slice(cred_all, (base_pid,), (vp,))
+
+        deg64 = deg_local.astype(jnp.float64 if state["lcc"].dtype == jnp.float64 else jnp.float32)
+        denom = deg64 * (deg64 - 1)
+        lcc = jnp.where(
+            jnp.logical_and(frag.inner_mask, deg_local >= 2),
+            2.0 * tri.astype(denom.dtype) / jnp.maximum(denom, 1),
+            0.0,
+        )
+        return {"lcc": lcc.astype(state["lcc"].dtype)}, jnp.int32(0)
+
+    def inceval(self, ctx: StepContext, frag, state):
+        return state, jnp.int32(0)
+
+    def finalize(self, frag, state):
+        return np.asarray(state["lcc"])

@@ -1,0 +1,1 @@
+from libgrape_lite_tpu.ops.segment import segment_reduce

@@ -1,0 +1,33 @@
+"""Segment reductions — the TPU ForEachEdge.
+
+The reference parallelises per-edge work with its CPU ParallelEngine
+(`grape/parallel/parallel_engine.h:32-719`) and the CUDA load-balancing
+kernel catalog (`grape/cuda/parallel/parallel_engine.h:42-1444`,
+cm/wm/cta/strict policies).  On TPU the same problem — distribute
+variable-degree adjacency work evenly — is solved by *edge-major*
+layout: per-edge values keyed by their row id, reduced with XLA segment
+ops, which lower to sorted-scatter kernels the compiler tiles evenly.
+A Pallas row-blocked variant lives alongside for the hot SpMV path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.ops as jops
+
+
+def segment_reduce(values, segment_ids, num_rows: int, kind: str = "sum"):
+    """Reduce `values` by `segment_ids` into `num_rows` rows.
+
+    Ids equal to `num_rows` (padding convention) land in an overflow row
+    that is sliced off — mirroring the reference's convention of routing
+    invalid work to a trash slot rather than branching.
+    """
+    fn = {
+        "sum": jops.segment_sum,
+        "min": jops.segment_min,
+        "max": jops.segment_max,
+        "prod": jops.segment_prod,
+    }[kind]
+    out = fn(values, segment_ids, num_segments=num_rows + 1)
+    return out[:num_rows]

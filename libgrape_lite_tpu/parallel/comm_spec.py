@@ -1,0 +1,56 @@
+"""Fragment <-> device topology.
+
+Re-design of `grape/worker/comm_spec.h:34-239`.  The reference maps one
+fragment to one MPI rank and discovers host topology with hostname
+allgathers.  On TPU the topology is a `jax.sharding.Mesh`: fragment fid i
+lives on mesh device i along the `frag` axis (the identity FragToWorker
+mapping of `comm_spec.h:128`), ICI replaces the intra-host communicator,
+and multi-slice DCN replaces the inter-host one.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+FRAG_AXIS = "frag"
+kCoordinatorRank = 0  # reference grape/config.h:64
+
+
+class CommSpec:
+    def __init__(self, fnum: int | None = None, devices=None):
+        if devices is None:
+            devices = jax.devices()
+        if fnum is None:
+            fnum = len(devices)
+        if fnum > len(devices):
+            raise ValueError(
+                f"fnum={fnum} exceeds available devices ({len(devices)}); "
+                "the TPU build maps one fragment per device"
+            )
+        self.fnum = fnum
+        self.devices = list(devices[:fnum])
+        self.mesh = Mesh(np.array(self.devices), (FRAG_AXIS,))
+        self.worker_num = fnum
+        self.worker_id = jax.process_index()
+
+    def frag_to_worker(self, fid: int) -> int:
+        return fid  # identity, like the reference
+
+    def worker_to_frag(self, wid: int) -> int:
+        return wid
+
+    def sharded(self, *trailing_dims_spec) -> NamedSharding:
+        """NamedSharding with the leading dim over the frag axis."""
+        return NamedSharding(self.mesh, P(FRAG_AXIS, *trailing_dims_spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.worker_id == kCoordinatorRank
+
+    def __repr__(self):
+        return f"CommSpec(fnum={self.fnum}, devices={len(self.devices)})"

@@ -1,0 +1,101 @@
+"""run_app equivalent: dispatch by app name, load, query, output.
+
+Re-design of `examples/analytical_apps/run_app.{cc,h}`
+(`run_app.h:103-323`: CreateAndQuery / DoQuery) and `utils.h` (DoQuery
+writes per-fragment results via `GetResultFilename`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+import numpy as np
+
+from libgrape_lite_tpu.fragment.loader import LoadGraph, LoadGraphSpec
+from libgrape_lite_tpu.models import APP_REGISTRY
+from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+from libgrape_lite_tpu.utils import timer
+from libgrape_lite_tpu.utils.types import LoadStrategy
+from libgrape_lite_tpu.worker.worker import Worker
+
+
+# which apps read edge weights (reference run_app.cc:48-52: SSSP uses
+# double edata, the rest EmptyType)
+_WEIGHTED_APPS = {"sssp"}
+
+
+@dataclass
+class QueryArgs:
+    """Flag bag (reference `examples/analytical_apps/flags.cc:23-69`)."""
+
+    application: str = "sssp"
+    efile: str = ""
+    vfile: str = ""
+    out_prefix: str = ""
+    directed: bool = False
+    sssp_source: int = 0
+    bfs_source: int = 0
+    pr_d: float = 0.85
+    pr_mr: int = 10
+    cdlp_mr: int = 10
+    degree_threshold: int = 0
+    fnum: int | None = None
+    partitioner_type: str = "map"
+    idxer_type: str = "hashmap"
+    serialize: bool = False
+    deserialize: bool = False
+    serialization_prefix: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def build_query_kwargs(app_name: str, args: QueryArgs) -> dict:
+    if app_name == "sssp":
+        return {"source": args.sssp_source}
+    if app_name == "bfs":
+        return {"source": args.bfs_source}
+    if app_name == "pagerank":
+        return {"delta": args.pr_d, "max_round": args.pr_mr}
+    if app_name == "cdlp":
+        return {"max_round": args.cdlp_mr}
+    return {}
+
+
+def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
+    name = args.application
+    if name not in APP_REGISTRY:
+        raise ValueError(
+            f"unknown application {name!r}; known: {sorted(APP_REGISTRY)}"
+        )
+    app_cls = APP_REGISTRY[name]
+    app = app_cls()
+
+    if comm_spec is None:
+        comm_spec = CommSpec(fnum=args.fnum)
+
+    weighted = name in _WEIGHTED_APPS
+    spec = LoadGraphSpec(
+        directed=args.directed,
+        weighted=weighted,
+        load_strategy=app_cls.load_strategy,
+        partitioner_type=args.partitioner_type,
+        idxer_type=args.idxer_type,
+        serialize=args.serialize,
+        deserialize=args.deserialize,
+        serialization_prefix=args.serialization_prefix,
+        edata_dtype=np.float64,
+    )
+
+    with timer.phase("load graph"):
+        frag = LoadGraph(args.efile, args.vfile or None, comm_spec, spec)
+
+    with timer.phase("load application"):
+        worker = Worker(app, frag)
+
+    with timer.phase("run algorithm"):
+        worker.query(**build_query_kwargs(name, args))
+
+    if args.out_prefix:
+        with timer.phase("print output"):
+            worker.output(args.out_prefix)
+    return worker

@@ -1,0 +1,2 @@
+from libgrape_lite_tpu.utils.types import EmptyType, LoadStrategy, MessageStrategy
+from libgrape_lite_tpu.utils.id_parser import IdParser

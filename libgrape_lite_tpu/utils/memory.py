@@ -1,0 +1,54 @@
+"""Device/host memory accounting.
+
+Re-design of the reference's `MemoryTracker` (`grape/utils/memory_tracker.h:26-43`)
+and `GetMemoryUsage` (`grape/util.h:51-69`): instead of interposing on
+malloc, we read live/peak bytes from the JAX device allocator and RSS
+from /proc.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+
+
+@dataclass
+class MemoryStats:
+    device_bytes_in_use: int
+    device_peak_bytes: int
+    host_rss_bytes: int
+
+    def __str__(self):
+        gb = 1 << 30
+        return (
+            f"device in-use {self.device_bytes_in_use / gb:.3f} GiB, "
+            f"device peak {self.device_peak_bytes / gb:.3f} GiB, "
+            f"host rss {self.host_rss_bytes / gb:.3f} GiB"
+        )
+
+
+def get_host_rss() -> int:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+def get_memory_stats(device=None) -> MemoryStats:
+    in_use = peak = 0
+    devs = [device] if device is not None else jax.local_devices()
+    for d in devs:
+        try:
+            ms = d.memory_stats()
+        except Exception:  # CPU backend has no allocator stats
+            ms = None
+        if ms:
+            in_use += ms.get("bytes_in_use", 0)
+            peak += ms.get("peak_bytes_in_use", 0)
+    return MemoryStats(in_use, peak, get_host_rss())

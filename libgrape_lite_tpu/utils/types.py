@@ -1,0 +1,76 @@
+"""Core type vocabulary.
+
+TPU-native re-design of the reference's `grape/types.h:36-198`: the enums
+keep the same names/semantics so apps written against the reference map
+1:1, but everything here is plain Python + numpy/JAX dtypes — there is no
+C++ template machinery to mirror because shape/dtype specialisation is
+done by XLA at trace time.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class EmptyType:
+    """Zero-byte payload marker (reference `grape/types.h:36-57`).
+
+    Used as the EDATA/VDATA type for unweighted graphs.  On TPU an
+    "empty" per-edge payload simply means the fragment does not
+    materialise an edge-data array at all.
+    """
+
+    __slots__ = ()
+
+    def __eq__(self, other):  # all instances equal, like the reference POD
+        return isinstance(other, EmptyType)
+
+    def __hash__(self):
+        return 0
+
+    def __repr__(self):
+        return "EmptyType()"
+
+
+class LoadStrategy(enum.Enum):
+    """How edges are attached to fragments (reference `grape/types.h:81-86`)."""
+
+    kOnlyOut = "only_out"
+    kOnlyIn = "only_in"
+    kBothOutIn = "both_out_in"
+    kNullLoadStrategy = "null"
+
+
+class MessageStrategy(enum.Enum):
+    """How cross-fragment messages flow (reference `grape/types.h:98-104`).
+
+    On TPU these select the collective pattern a message manager uses:
+
+    * kAlongEdgeToOuterVertex / kAlongOutgoingEdgeToOuterVertex /
+      kAlongIncomingEdgeToOuterVertex — per-destination message tensors
+      exchanged with `all_to_all` (push model).
+    * kSyncOnOuterVertex — mirror sync via `all_gather` / `ppermute`.
+    * kGatherScatter — vertex-cut segment reduce + broadcast.
+    """
+
+    kAlongOutgoingEdgeToOuterVertex = "along_out_edge"
+    kAlongIncomingEdgeToOuterVertex = "along_in_edge"
+    kAlongEdgeToOuterVertex = "along_edge"
+    kSyncOnOuterVertex = "sync_on_outer_vertex"
+    kGatherScatter = "gather_scatter"
+
+
+# Default integer dtypes. The reference uses `fid_t = unsigned`
+# (`grape/config.h:40-43`) and vid widths uint32/uint64 chosen by the
+# `--opt` flag (`examples/analytical_apps/run_app.cc:48-52`). On TPU we
+# default to int32 (native lane width); int64 is available for huge
+# graphs and for exact-parity CPU testing under x64.
+FID_DTYPE = np.int32
+VID_DTYPE = np.int32
+VID64_DTYPE = np.int64
+
+
+def is_empty_type(t) -> bool:
+    return t is EmptyType or isinstance(t, EmptyType) or t is None

@@ -1,0 +1,15 @@
+from libgrape_lite_tpu.vertex_map.partitioner import (
+    HashPartitioner,
+    MapPartitioner,
+    SegmentedPartitioner,
+    VCPartitioner,
+    make_partitioner,
+)
+from libgrape_lite_tpu.vertex_map.idxer import (
+    HashMapIdxer,
+    SortedArrayIdxer,
+    LocalIdxer,
+    PerfectHashIdxer,
+    make_idxer,
+)
+from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap

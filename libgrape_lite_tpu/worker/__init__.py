@@ -1,0 +1,1 @@
+from libgrape_lite_tpu.worker.worker import Worker

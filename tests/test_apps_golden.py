@@ -1,0 +1,89 @@
+"""Golden-file app tests, the analogue of `misc/app_tests.sh`:
+every app × fragment counts {1,2,4,8} (the reference's `mpirun -n N`),
+verified exact / eps / isomorphism against `dataset/p2p-31-*`.
+"""
+
+import numpy as np
+import pytest
+
+from tests.conftest import dataset_path
+from tests.verifiers import (
+    eps_verify,
+    exact_verify,
+    load_golden,
+    load_result_lines,
+    wcc_verify,
+)
+
+FNUMS = [1, 2, 4, 8]
+
+
+def run_worker(app, frag, **kwargs):
+    from libgrape_lite_tpu.worker.worker import Worker, format_result_lines
+
+    w = Worker(app, frag)
+    w.query(**kwargs)
+    values = w.result_values()
+    chunks = []
+    for f in range(frag.fnum):
+        n = frag.inner_vertices_num(f)
+        chunks.append(
+            format_result_lines(
+                frag.inner_oids(f), values[f, :n], app.result_format
+            )
+        )
+    return load_result_lines("".join(chunks))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_sssp(graph_cache, fnum):
+    from libgrape_lite_tpu.models import SSSP
+
+    frag = graph_cache(fnum)
+    res = run_worker(SSSP(), frag, source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-SSSP")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_bfs(graph_cache, fnum):
+    from libgrape_lite_tpu.models import BFS
+
+    frag = graph_cache(fnum)
+    res = run_worker(BFS(), frag, source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-BFS")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_pagerank(graph_cache, fnum):
+    from libgrape_lite_tpu.models import PageRank
+
+    frag = graph_cache(fnum)
+    res = run_worker(PageRank(), frag, delta=0.85, max_round=10)
+    eps_verify(res, load_golden(dataset_path("p2p-31-PR")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_wcc(graph_cache, fnum):
+    from libgrape_lite_tpu.models import WCC
+
+    frag = graph_cache(fnum)
+    res = run_worker(WCC(), frag)
+    wcc_verify(res, load_golden(dataset_path("p2p-31-WCC")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_cdlp(graph_cache, fnum):
+    from libgrape_lite_tpu.models import CDLP
+
+    frag = graph_cache(fnum)
+    res = run_worker(CDLP(), frag, max_round=10)
+    exact_verify(res, load_golden(dataset_path("p2p-31-CDLP")))
+
+
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_lcc(graph_cache, fnum):
+    from libgrape_lite_tpu.models import LCC
+
+    frag = graph_cache(fnum)
+    res = run_worker(LCC(), frag)
+    eps_verify(res, load_golden(dataset_path("p2p-31-LCC")))

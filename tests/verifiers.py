@@ -1,0 +1,82 @@
+"""Result verifiers, ported behaviorally from the reference harness:
+
+* exact — byte-equal sorted compare (`misc/app_tests.sh:6-16`)
+* eps — relative tolerance 1e-4 (`misc/eps_check.cc:24,56`)
+* wcc — component partition isomorphism (`misc/wcc_check.cc`)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+COMPARISON_THRESHOLD = 1e-4  # eps_check.cc:24
+NEAR_INFINITY_FRAC = 0.999  # eps_check.cc:22
+
+
+def load_result_lines(text: str) -> dict:
+    out = {}
+    for line in text.strip().splitlines():
+        k, v = line.split()
+        out[int(k)] = v
+    return out
+
+
+def load_golden(path: str) -> dict:
+    with open(path) as f:
+        return load_result_lines(f.read())
+
+
+def exact_verify(result: dict, golden: dict) -> None:
+    assert result.keys() == golden.keys(), (
+        f"vertex sets differ: {len(result)} vs {len(golden)}"
+    )
+    bad = []
+    for k, v in golden.items():
+        r = result[k]
+        if _norm_num(r) != _norm_num(v):
+            bad.append((k, r, v))
+            if len(bad) >= 5:
+                break
+    assert not bad, f"exact mismatch (first {len(bad)}): {bad}"
+
+
+def _norm_num(s: str):
+    try:
+        f = float(s)
+        return f
+    except ValueError:
+        return s
+
+
+def eps_verify(result: dict, golden: dict) -> None:
+    assert result.keys() == golden.keys()
+    bad = []
+    for k, v in golden.items():
+        g = float(v)
+        r = float(result[k])
+        if g == 0:
+            ok = abs(r) < 1e-12
+        else:
+            ok = abs(r - g) <= COMPARISON_THRESHOLD * abs(g)
+        if not ok:
+            bad.append((k, r, g))
+            if len(bad) >= 5:
+                break
+    assert not bad, f"eps mismatch (first {len(bad)}): {bad}"
+
+
+def wcc_verify(result: dict, golden: dict) -> None:
+    """Partition isomorphism: same grouping, arbitrary labels."""
+    assert result.keys() == golden.keys()
+    fwd = {}
+    bwd = {}
+    for k in golden:
+        g, r = golden[k], result[k]
+        if g in fwd:
+            assert fwd[g] == r, f"vertex {k}: golden comp {g} split in result"
+        else:
+            fwd[g] = r
+        if r in bwd:
+            assert bwd[r] == g, f"vertex {k}: result comp {r} merges golden comps"
+        else:
+            bwd[r] = g
