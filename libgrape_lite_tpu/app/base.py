@@ -134,14 +134,47 @@ class BatchShuffleAppBase(AppBase):
 
 
 class AutoAppBase(AppBase):
-    """Auto-messaging app: state sync is implied by declared SyncBuffers
-    (reference `auto_app_base.h`, `auto_parallel_message_manager.h:47-365`).
-    In the TPU build `sync_buffers` maps state-key -> aggregate kind
-    ('min'|'max'|'sum'); the driver gathers and aggregates automatically,
-    so subclasses only write the local compute in `compute(ctx, frag,
-    state, gathered)`."""
+    """Auto-messaging app (reference `auto_app_base.h:38-84` +
+    `auto_parallel_message_manager.h:47-365`): the app registers
+    SyncBuffers (state-key -> aggregate op) and writes only the local
+    compute; messaging is implicit.
+
+    TPU mapping: `propose(ctx, frag, state)` returns, per synced key, a
+    full pid-indexed [n_pad] proposal array (neutral element where the
+    shard has nothing to say — the push-model scatter of
+    generateAutoMessages); the framework all-reduces proposals with the
+    buffer op (aggregateAutoMessages) and hands each shard its slice to
+    `update` (default: adopt it, vote active while anything changed)."""
 
     sync_buffers: Dict[str, str] = {}
+
+    def propose(self, ctx: StepContext, frag: DeviceFragment, state: Dict):
+        raise NotImplementedError
+
+    def update(self, ctx: StepContext, frag: DeviceFragment, state: Dict,
+               combined: Dict):
+        changed_any = jnp.int32(0)
+        new_state = dict(state)
+        for k in self.sync_buffers:
+            new = combined[k]
+            changed = jnp.logical_and(new != state[k], frag.inner_mask)
+            changed_any = changed_any + changed.sum().astype(jnp.int32)
+            new_state[k] = new
+        return new_state, ctx.sum(changed_any)
+
+    def peval(self, ctx, frag, state):
+        return state, jnp.int32(1)
+
+    def inceval(self, ctx, frag, state):
+        from libgrape_lite_tpu.parallel.message_manager import (
+            AutoParallelMessageManager,
+        )
+
+        proposals = self.propose(ctx, frag, state)
+        combined = AutoParallelMessageManager.sync(
+            frag, proposals, self.sync_buffers
+        )
+        return self.update(ctx, frag, state, combined)
 
 
 class GatherScatterAppBase(AppBase):

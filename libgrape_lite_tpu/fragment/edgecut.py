@@ -174,6 +174,15 @@ class ShardedEdgecutFragment:
     def inner_vertices_num(self, fid: int) -> int:
         return int(np.asarray(self.dev.ivnum)[fid])
 
+    def host_inner_mask(self) -> np.ndarray:
+        """[fnum, vp] bool: True for real (non-padding) vertex rows —
+        the single source of truth for padding semantics on the host
+        side (device side: DeviceFragment.inner_mask)."""
+        ivnum = np.array(
+            [self.inner_vertices_num(f) for f in range(self.fnum)]
+        )
+        return np.arange(self.vp)[None, :] < ivnum[:, None]
+
     def inner_oids(self, fid: int) -> np.ndarray:
         return self.vertex_map.inner_oids(fid)
 

@@ -2,6 +2,16 @@
 
 Each app is a PIE program: host-side `init_state` (PEval's setup),
 traced `peval`/`inceval` supersteps, host-side `finalize` (Assemble).
+
+The registry mirrors the reference's app-variant names
+(`run_app.h:214-296` dispatch).  Variants that differ only by CPU-side
+execution strategy (e.g. `*_opt` = SIMD/pooled-buffer builds of the
+same algorithm) map to the same TPU implementation — XLA owns those
+concerns; variants with genuinely different communication patterns
+(`*_auto` = SyncBuffer push, `pagerank_push`) have distinct classes.
+Exceptions: cdlp_auto / lcc_auto alias the base apps — their SyncBuffer
+is a plain mirror-overwrite (no aggregate op), which the gather model
+performs inherently, so push and pull coincide.
 """
 
 from libgrape_lite_tpu.models.pagerank import PageRank
@@ -10,12 +20,46 @@ from libgrape_lite_tpu.models.bfs import BFS
 from libgrape_lite_tpu.models.wcc import WCC
 from libgrape_lite_tpu.models.cdlp import CDLP
 from libgrape_lite_tpu.models.lcc import LCC
+from libgrape_lite_tpu.models.bc import BC
+from libgrape_lite_tpu.models.kcore import KCore
+from libgrape_lite_tpu.models.core_decomposition import CoreDecomposition
+from libgrape_lite_tpu.models.pagerank_local import PageRankLocal
+from libgrape_lite_tpu.models.kclique import KClique
+from libgrape_lite_tpu.models.auto_apps import (
+    BFSAuto,
+    PageRankAuto,
+    SSSPAuto,
+    WCCAuto,
+)
 
 APP_REGISTRY = {
-    "pagerank": PageRank,
     "sssp": SSSP,
+    "sssp_auto": SSSPAuto,
+    "sssp_opt": SSSP,
     "bfs": BFS,
+    "bfs_auto": BFSAuto,
+    "bfs_opt": BFS,
     "wcc": WCC,
+    "wcc_auto": WCCAuto,
+    "wcc_opt": WCC,
+    "pagerank": PageRank,
+    "pagerank_auto": PageRankAuto,
+    "pagerank_parallel": PageRank,
+    "pagerank_opt": PageRank,
+    "pagerank_push": PageRankAuto,
     "cdlp": CDLP,
+    "cdlp_auto": CDLP,
+    "cdlp_opt": CDLP,
+    "cdlp_opt_ud": CDLP,
+    "cdlp_opt_ud_dense": CDLP,
     "lcc": LCC,
+    "lcc_auto": LCC,
+    "lcc_opt": LCC,
+    "lcc_beta": LCC,
+    "bc": BC,
+    "kcore": KCore,
+    "kclique": KClique,
+    "core_decomposition": CoreDecomposition,
+    "pagerank_local": PageRankLocal,
+    "pagerank_local_parallel": PageRankLocal,
 }

@@ -1,0 +1,116 @@
+"""Auto-parallel app variants (reference `sssp_auto.h`, `bfs_auto.h`,
+`wcc_auto.h`, `pagerank_auto.h` under `examples/analytical_apps/`).
+
+These exercise the SyncBuffer/auto-messaging path: instead of the
+explicit pull (gather + per-row reduce) of the base apps, state updates
+are *pushed* — scattered by destination pid with a segment reduce and
+combined across shards by the SyncBuffer's aggregate op (`pmin`/`psum`).
+Results are identical; the execution strategy differs, which is exactly
+the relationship the reference variants have to their base apps.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.ops as jops
+import numpy as np
+from jax import lax
+
+from libgrape_lite_tpu.app.base import AutoAppBase, StepContext
+from libgrape_lite_tpu.models.bfs import BFS, _SENTINEL
+from libgrape_lite_tpu.models.pagerank import PageRank
+from libgrape_lite_tpu.models.sssp import SSSP
+from libgrape_lite_tpu.models.wcc import WCC
+from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
+
+
+def _own_slice_min(prop, local, frag):
+    """Fold the shard's own current values into its slice of the
+    proposal array (a vertex is always a proposal source for itself)."""
+    fid = lax.axis_index(FRAG_AXIS)
+    start = fid * frag.vp
+    own = lax.dynamic_slice(prop, (start,), (frag.vp,))
+    return lax.dynamic_update_slice(prop, jnp.minimum(own, local), (start,))
+
+
+class SSSPAuto(AutoAppBase, SSSP):
+    """SSSP via SyncBuffer<dist, min> (reference sssp_auto.h)."""
+
+    sync_buffers = {"dist": "min"}
+
+    def propose(self, ctx: StepContext, frag, state):
+        dist = state["dist"]
+        oe = frag.oe
+        n_pad = frag.fnum * frag.vp
+        inf = jnp.asarray(jnp.inf, dist.dtype)
+        src_dist = dist[jnp.minimum(oe.edge_src, frag.vp - 1)]
+        cand = jnp.where(oe.edge_mask, src_dist + oe.edge_w, inf)
+        prop = jops.segment_min(cand, oe.edge_nbr, num_segments=n_pad)
+        return {"dist": _own_slice_min(prop, dist, frag)}
+
+
+class BFSAuto(AutoAppBase, BFS):
+    """BFS via SyncBuffer<depth, min> (reference bfs_auto.h)."""
+
+    sync_buffers = {"depth": "min"}
+
+    def propose(self, ctx: StepContext, frag, state):
+        depth = state["depth"]
+        oe = frag.oe
+        n_pad = frag.fnum * frag.vp
+        sent = jnp.int32(_SENTINEL)
+        src_d = depth[jnp.minimum(oe.edge_src, frag.vp - 1)]
+        cand = jnp.where(
+            jnp.logical_and(oe.edge_mask, src_d != sent), src_d + 1, sent
+        )
+        prop = jops.segment_min(cand, oe.edge_nbr, num_segments=n_pad)
+        return {"depth": _own_slice_min(prop, depth, frag)}
+
+
+class WCCAuto(AutoAppBase, WCC):
+    """WCC via SyncBuffer<comp, min> (reference wcc_auto.h): labels are
+    pushed along both edge directions."""
+
+    sync_buffers = {"comp": "min"}
+
+    def propose(self, ctx: StepContext, frag, state):
+        comp = state["comp"]
+        n_pad = frag.fnum * frag.vp
+        big = jnp.int32(np.iinfo(np.int32).max)
+
+        def push(csr, prop):
+            src_c = comp[jnp.minimum(csr.edge_src, frag.vp - 1)]
+            cand = jnp.where(csr.edge_mask, src_c, big)
+            return jnp.minimum(
+                prop, jops.segment_min(cand, csr.edge_nbr, num_segments=n_pad)
+            )
+
+        prop = push(frag.oe, jnp.full((n_pad,), big, comp.dtype))
+        if frag.directed:
+            prop = push(frag.ie, prop)
+        return {"comp": _own_slice_min(prop, comp, frag)}
+
+
+class PageRankAuto(AutoAppBase, PageRank):
+    """PageRank via SyncBuffer<rank, sum> (reference pagerank_auto.h):
+    contributions are scattered along out-edges and psum-combined."""
+
+    sync_buffers = {"rank": "sum"}
+    replicated_keys = PageRank.replicated_keys
+
+    # PageRank's PEval (degree/dangling setup) applies unchanged
+    peval = PageRank.peval
+
+    def propose(self, ctx: StepContext, frag, state):
+        rank = state["rank"]
+        oe = frag.oe
+        n_pad = frag.fnum * frag.vp
+        dt = rank.dtype
+        src_r = rank[jnp.minimum(oe.edge_src, frag.vp - 1)]
+        cand = jnp.where(oe.edge_mask, src_r, jnp.asarray(0, dt))
+        prop = jops.segment_sum(cand, oe.edge_nbr, num_segments=n_pad)
+        return {"rank": prop}
+
+    def update(self, ctx: StepContext, frag, state, combined):
+        # psum of pushed contributions = the in-neighbor rank sum
+        return self.round_update(frag, state, combined["rank"])

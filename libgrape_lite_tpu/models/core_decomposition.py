@@ -1,0 +1,72 @@
+"""CoreDecomposition — per-vertex core numbers by level peeling.
+
+Re-design of `examples/analytical_apps/core_decomposition/
+core_decomposition.h`: peel level by level; at level L, repeatedly pin
+every alive vertex whose residual degree <= L to core number L until
+the level drains, then advance (the reference's nested
+curr/next_inner_updated worklists).
+
+TPU formulation: one `lax.while_loop` whose body does a single
+synchronous sub-round of the current level (gather alive bitmap +
+`segment_sum` residual degrees + pin), advancing the level only on
+sub-rounds that removed nothing.  Same fixpoint as the reference's
+nested loops, expressed as a flat loop so XLA keeps everything on
+device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from libgrape_lite_tpu.app.base import ParallelAppBase, StepContext
+from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
+
+
+class CoreDecomposition(ParallelAppBase):
+    load_strategy = LoadStrategy.kOnlyOut
+    message_strategy = MessageStrategy.kSyncOnOuterVertex
+    result_format = "int"
+    replicated_keys = frozenset({"level"})
+
+    def init_state(self, frag, **_):
+        return {
+            "core": np.zeros((frag.fnum, frag.vp), dtype=np.int32),
+            "alive": frag.host_inner_mask(),
+            "level": np.int32(1),
+        }
+
+    def peval(self, ctx: StepContext, frag, state):
+        alive = jnp.logical_and(state["alive"], frag.out_degree > 0)
+        return dict(state, alive=alive), jnp.int32(1)
+
+    def inceval(self, ctx: StepContext, frag, state):
+        core, alive, level = state["core"], state["alive"], state["level"]
+        ie = frag.ie
+        full = ctx.gather_state(alive.astype(jnp.int32))
+        resid = self.segment_reduce(
+            jnp.where(ie.edge_mask, full[ie.edge_nbr], 0), ie.edge_src,
+            frag.vp, "sum",
+        )
+        pin = jnp.logical_and(alive, resid <= level)
+        core2 = jnp.where(pin, level, core)
+        alive2 = jnp.logical_and(alive, ~pin)
+
+        n_pinned = ctx.sum(pin.sum().astype(jnp.int32))
+        n_alive = ctx.sum(alive2.sum().astype(jnp.int32))
+        # drained this level -> jump straight to the smallest remaining
+        # residual degree (skipping empty levels costs one pmin instead
+        # of one full superstep each)
+        big = jnp.int32(np.iinfo(np.int32).max)
+        min_resid = ctx.min(
+            jnp.where(alive2, resid, big).min().astype(jnp.int32)
+        )
+        level2 = jnp.where(
+            n_pinned == 0, jnp.maximum(level + 1, min_resid), level
+        )
+        active = jnp.where(n_alive > 0, jnp.int32(1), jnp.int32(0))
+        return {"core": core2, "alive": alive2, "level": level2}, active
+
+    def finalize(self, frag, state):
+        return np.asarray(state["core"]).astype(np.int64)

@@ -1,0 +1,59 @@
+"""KCore — iterative k-core peeling.
+
+Re-design of `examples/analytical_apps/kcore/kcore.h`: vertices with
+residual degree < k are removed; removals decrement neighbor degrees;
+iterate to fixpoint (the reference pushes per-removal decrement
+messages, `kcore.h` IncEval).
+
+TPU formulation: dense synchronous peeling — each round recomputes the
+alive-neighbor count with one gather + `segment_sum` and drops every
+under-k vertex at once (the message traffic of the reference becomes
+the all_gather of the alive bitmap).  Fixpoint via psum vote.
+
+Result: per-vertex membership (1 if in the k-core else 0) — the
+reference's per-vertex artifact is the residual-degree array consumed
+as `result >= k` (`kcore_context.h` Output counts exactly that).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from libgrape_lite_tpu.app.base import ParallelAppBase, StepContext
+from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
+
+
+class KCore(ParallelAppBase):
+    load_strategy = LoadStrategy.kOnlyOut
+    message_strategy = MessageStrategy.kSyncOnOuterVertex
+    result_format = "int"
+
+    def __init__(self, k: int = 0):
+        self.k = k
+
+    def init_state(self, frag, k: int | None = None):
+        if k is not None:
+            self.k = k
+        return {"alive": frag.host_inner_mask()}
+
+    def peval(self, ctx: StepContext, frag, state):
+        # initial cut: degree < k (kcore.h PEval)
+        alive = jnp.logical_and(state["alive"], frag.out_degree >= self.k)
+        return {"alive": alive}, jnp.int32(1)
+
+    def inceval(self, ctx: StepContext, frag, state):
+        alive = state["alive"]
+        ie = frag.ie
+        full = ctx.gather_state(alive.astype(jnp.int32))
+        cnt = self.segment_reduce(
+            jnp.where(ie.edge_mask, full[ie.edge_nbr], 0), ie.edge_src,
+            frag.vp, "sum",
+        )
+        removed = jnp.logical_and(alive, cnt < self.k)
+        new_alive = jnp.logical_and(alive, ~removed)
+        active = ctx.sum(removed.sum().astype(jnp.int32))
+        return {"alive": new_alive}, active
+
+    def finalize(self, frag, state):
+        return np.asarray(state["alive"]).astype(np.int64)

@@ -28,7 +28,10 @@ from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
 
 
 class PageRank(BatchShuffleAppBase):
-    load_strategy = LoadStrategy.kOnlyOut
+    # kBothOutIn like pagerank_parallel.h:46 — the pull reads incoming
+    # edges while the normalisation uses the out-degree; on undirected
+    # graphs the two CSRs alias so this costs nothing extra
+    load_strategy = LoadStrategy.kBothOutIn
     message_strategy = MessageStrategy.kAlongOutgoingEdgeToOuterVertex
     need_split_edges = True
     result_format = "float"
@@ -78,19 +81,17 @@ class PageRank(BatchShuffleAppBase):
         )
         return state, jnp.int32(1 if self.max_round > 0 else 0)
 
-    def inceval(self, ctx: StepContext, frag, state):
+    def round_update(self, frag, state, cur):
+        """One PageRank round given the in-neighbor rank sum `cur` —
+        shared by the pull path (inceval) and the push/SyncBuffer path
+        (PageRankAuto): base/dangling bookkeeping, degree division, and
+        the final-round rank*deg re-multiplication (pagerank.h:102-156)."""
         n = frag.total_vnum
         d = self.delta
-        rank = state["rank"]
-        dt = rank.dtype
+        dt = state["rank"].dtype
         step = state["step"] + 1
         base = jnp.asarray((1.0 - d) / n, dt) + jnp.asarray(d / n, dt) * state["dangling_sum"]
         dangling_sum = base * state["total_dangling"]
-
-        oe = frag.oe
-        full = ctx.gather_state(rank)
-        contrib = jnp.where(oe.edge_mask, full[oe.edge_nbr], jnp.asarray(0, dt))
-        cur = self.segment_reduce(contrib, oe.edge_src, frag.vp, "sum")
         deg = frag.out_degree
         nxt = jnp.where(
             deg > 0,
@@ -111,6 +112,19 @@ class PageRank(BatchShuffleAppBase):
             total_dangling=state["total_dangling"],
         )
         return new_state, jnp.where(is_last, jnp.int32(0), jnp.int32(1))
+
+    def inceval(self, ctx: StepContext, frag, state):
+        # pull over incoming edges (pagerank_parallel.h:128-136: for
+        # undirected graphs this equals the out-adjacency pull of
+        # pagerank.h:122-128, and it is the correct direction when
+        # --directed)
+        rank = state["rank"]
+        dt = rank.dtype
+        ie = frag.ie
+        full = ctx.gather_state(rank)
+        contrib = jnp.where(ie.edge_mask, full[ie.edge_nbr], jnp.asarray(0, dt))
+        cur = self.segment_reduce(contrib, ie.edge_src, frag.vp, "sum")
+        return self.round_update(frag, state, cur)
 
     def finalize(self, frag, state):
         return np.asarray(state["rank"])

@@ -27,6 +27,7 @@ class SSSP(ParallelAppBase):
     load_strategy = LoadStrategy.kBothOutIn
     message_strategy = MessageStrategy.kSyncOnOuterVertex
     result_format = "sssp_infinity"
+    needs_edata = True  # double edata (run_app.cc:48-52)
 
     def init_state(self, frag, source=0):
         dtype = frag.host_ie[0].edge_w.dtype if frag.weighted else np.float32

@@ -30,9 +30,7 @@ class WCC(ParallelAppBase):
         vp = frag.vp
         pids = np.arange(frag.fnum * vp, dtype=np.int32).reshape(frag.fnum, vp)
         # padded rows get a big sentinel so they never win a min
-        ivnum = np.array([frag.inner_vertices_num(f) for f in range(frag.fnum)])
-        mask = np.arange(vp)[None, :] < ivnum[:, None]
-        comp = np.where(mask, pids, np.iinfo(np.int32).max)
+        comp = np.where(frag.host_inner_mask(), pids, np.iinfo(np.int32).max)
         return {"comp": comp.astype(np.int32)}
 
     def peval(self, ctx: StepContext, frag, state):

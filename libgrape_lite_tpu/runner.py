@@ -20,9 +20,6 @@ from libgrape_lite_tpu.utils.types import LoadStrategy
 from libgrape_lite_tpu.worker.worker import Worker
 
 
-# which apps read edge weights (reference run_app.cc:48-52: SSSP uses
-# double edata, the rest EmptyType)
-_WEIGHTED_APPS = {"sssp"}
 
 
 @dataclass
@@ -36,6 +33,9 @@ class QueryArgs:
     directed: bool = False
     sssp_source: int = 0
     bfs_source: int = 0
+    bc_source: int = 0
+    kcore_k: int = 0
+    kclique_k: int = 3
     pr_d: float = 0.85
     pr_mr: int = 10
     cdlp_mr: int = 10
@@ -50,13 +50,19 @@ class QueryArgs:
 
 
 def build_query_kwargs(app_name: str, args: QueryArgs) -> dict:
-    if app_name == "sssp":
+    if app_name.startswith("sssp"):
         return {"source": args.sssp_source}
-    if app_name == "bfs":
+    if app_name.startswith("bfs"):
         return {"source": args.bfs_source}
-    if app_name == "pagerank":
+    if app_name == "bc":
+        return {"source": args.bc_source}
+    if app_name == "kcore":
+        return {"k": args.kcore_k}
+    if app_name == "kclique":
+        return {"k": args.kclique_k}
+    if app_name.startswith("pagerank"):
         return {"delta": args.pr_d, "max_round": args.pr_mr}
-    if app_name == "cdlp":
+    if app_name.startswith("cdlp"):
         return {"max_round": args.cdlp_mr}
     return {}
 
@@ -73,7 +79,7 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
     if comm_spec is None:
         comm_spec = CommSpec(fnum=args.fnum)
 
-    weighted = name in _WEIGHTED_APPS
+    weighted = getattr(app_cls, "needs_edata", False)
     spec = LoadGraphSpec(
         directed=args.directed,
         weighted=weighted,

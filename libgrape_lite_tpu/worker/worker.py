@@ -127,6 +127,13 @@ class Worker:
         frag = self.fragment
         mr = app.max_rounds if max_rounds is None else max_rounds
 
+        if getattr(app, "host_only", False):
+            # host-engine apps (irregular recursion, e.g. kclique) skip
+            # the traced superstep loop entirely
+            self._result_state = app.host_compute(frag, **query_args)
+            self.rounds = 0
+            return self._result_state
+
         state_np = app.init_state(frag, **query_args)
         # place state: sharded leaves over frag axis, the rest replicated
         shard = self.comm_spec.sharded()

@@ -1,0 +1,38 @@
+"""Directed-graph golden tests (`misc/app_tests.sh`: sssp/bfs with
+--directed vs p2p-31-SSSP-directed / -BFS-directed; pagerank_parallel
+--directed vs p2p-31-PR-directed)."""
+
+import pytest
+
+from tests.conftest import dataset_path
+from tests.test_apps_golden import run_worker
+from tests.verifiers import eps_verify, exact_verify, load_golden
+
+FNUMS = [1, 4]
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_sssp_directed(graph_cache, fnum):
+    from libgrape_lite_tpu.models import SSSP
+
+    frag = graph_cache(fnum, directed=True)
+    res = run_worker(SSSP(), frag, source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-SSSP-directed")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_bfs_directed(graph_cache, fnum):
+    from libgrape_lite_tpu.models import BFS
+
+    frag = graph_cache(fnum, directed=True)
+    res = run_worker(BFS(), frag, source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-BFS-directed")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_pagerank_directed(graph_cache, fnum):
+    from libgrape_lite_tpu.models import PageRank
+
+    frag = graph_cache(fnum, directed=True)
+    res = run_worker(PageRank(), frag, delta=0.85, max_round=10)
+    eps_verify(res, load_golden(dataset_path("p2p-31-PR-directed")))

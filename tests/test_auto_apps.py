@@ -1,0 +1,53 @@
+"""Auto-variant apps (SyncBuffer push path) vs golden files —
+the analogue of app_tests.sh's sssp_auto/bfs_auto/wcc_auto/pagerank_auto
+runs."""
+
+import pytest
+
+from tests.conftest import dataset_path
+from tests.test_apps_golden import run_worker
+from tests.verifiers import eps_verify, exact_verify, load_golden, wcc_verify
+
+FNUMS = [2, 8]
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_sssp_auto(graph_cache, fnum):
+    from libgrape_lite_tpu.models import SSSPAuto
+
+    res = run_worker(SSSPAuto(), graph_cache(fnum), source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-SSSP")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_bfs_auto(graph_cache, fnum):
+    from libgrape_lite_tpu.models import BFSAuto
+
+    res = run_worker(BFSAuto(), graph_cache(fnum), source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-BFS")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_wcc_auto(graph_cache, fnum):
+    from libgrape_lite_tpu.models import WCCAuto
+
+    res = run_worker(WCCAuto(), graph_cache(fnum))
+    wcc_verify(res, load_golden(dataset_path("p2p-31-WCC")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_pagerank_auto(graph_cache, fnum):
+    from libgrape_lite_tpu.models import PageRankAuto
+
+    res = run_worker(PageRankAuto(), graph_cache(fnum), delta=0.85, max_round=10)
+    eps_verify(res, load_golden(dataset_path("p2p-31-PR")))
+
+
+@pytest.mark.parametrize("fnum", [1, 2])
+def test_pagerank_auto_directed(graph_cache, fnum):
+    from libgrape_lite_tpu.models import PageRankAuto
+
+    res = run_worker(
+        PageRankAuto(), graph_cache(fnum, directed=True), delta=0.85, max_round=10
+    )
+    eps_verify(res, load_golden(dataset_path("p2p-31-PR-directed")))

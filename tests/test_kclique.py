@@ -1,0 +1,61 @@
+"""KClique vs brute-force enumeration on small graphs, plus a CLI
+dispatch smoke test (regression: app flags not wired through the
+runner)."""
+
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+from tests.test_worker import build_fragment
+
+
+def brute_force_kcliques(n, src, dst, k):
+    adj = [set() for _ in range(n)]
+    for a, b in zip(src.tolist(), dst.tolist()):
+        if a != b:
+            adj[a].add(b)
+            adj[b].add(a)
+    cnt = 0
+    for combo in combinations(range(n), k):
+        if all(b in adj[a] for a, b in combinations(combo, 2)):
+            cnt += 1
+    return cnt
+
+
+@pytest.mark.parametrize("k", [2, 3, 4, 5])
+@pytest.mark.parametrize("fnum", [1, 2])
+def test_kclique_counts(k, fnum):
+    from libgrape_lite_tpu.models import KClique
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    rng = np.random.default_rng(5)
+    n, e = 24, 120  # dense enough to have plenty of 4/5-cliques
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    frag = build_fragment(src, dst, None, n, fnum)
+    app = KClique()
+    w = Worker(app, frag)
+    w.query(k=k)
+    expect = brute_force_kcliques(n, src, dst, k)
+    assert app.total_cliques == expect
+
+
+def test_cli_query_kwargs_dispatch():
+    """Every registered app name must resolve its query kwargs without
+    falling through to {} when it has parameters (regression: bc/kcore
+    flags were not wired)."""
+    from libgrape_lite_tpu.models import APP_REGISTRY
+    from libgrape_lite_tpu.runner import QueryArgs, build_query_kwargs
+
+    args = QueryArgs(
+        sssp_source=6, bfs_source=6, bc_source=6, kcore_k=4, kclique_k=4
+    )
+    assert build_query_kwargs("sssp_auto", args) == {"source": 6}
+    assert build_query_kwargs("bfs_auto", args) == {"source": 6}
+    assert build_query_kwargs("bc", args) == {"source": 6}
+    assert build_query_kwargs("kcore", args) == {"k": 4}
+    assert build_query_kwargs("kclique", args) == {"k": 4}
+    assert build_query_kwargs("pagerank_local", args)["max_round"] == 10
+    for name in APP_REGISTRY:
+        build_query_kwargs(name, args)  # must not raise
