@@ -35,6 +35,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--serialize", action="store_true")
     p.add_argument("--deserialize", action="store_true")
     p.add_argument("--serialization_prefix", default="")
+    p.add_argument("--vc", action="store_true",
+                   help="vertex-cut (2-D) storage; fnum must be k^2")
+    p.add_argument("--delta_efile", default="")
+    p.add_argument("--delta_vfile", default="")
     p.add_argument("--platform", default="",
                    help="jax platform override (e.g. cpu); default ambient")
     p.add_argument("--cpu_devices", type=int, default=0,

@@ -160,6 +160,10 @@ class ShardedEdgecutFragment:
         self.fnum = device_fragment.fnum
         self.vp = device_fragment.vp
         self.id_parser = IdParser(self.fnum, self.vp)
+        # original (pre-symmetrisation) oid edge list, retained when the
+        # fragment was built mutable (reference MutableEdgecutFragment
+        # keeps slack CSRs instead; we rebuild-on-mutate)
+        self.edge_list = None
 
     # ---- FragmentBase API parity (fragment_base.h:50-133) ----
 
@@ -215,6 +219,7 @@ class ShardedEdgecutFragment:
         load_strategy: LoadStrategy = LoadStrategy.kBothOutIn,
         vid_dtype=np.int32,
         edata_dtype=np.float32,
+        retain_edge_list: bool = False,
     ) -> "ShardedEdgecutFragment":
         """Distribute edges to owner fragments and build padded CSRs.
 
@@ -304,8 +309,15 @@ class ShardedEdgecutFragment:
             comm_spec, vertex_map, host_oe, host_ie, vp, directed,
             total_vnum, real_enum,
         )
-        return cls(comm_spec, vertex_map, dev, host_oe, host_ie, directed,
-                   weights is not None)
+        out = cls(comm_spec, vertex_map, dev, host_oe, host_ie, directed,
+                  weights is not None)
+        if retain_edge_list:
+            out.edge_list = (
+                np.asarray(src_oid).copy(),
+                np.asarray(dst_oid).copy(),
+                None if weights is None else np.asarray(weights)[: len(src_oid)].copy(),
+            )
+        return out
 
     @staticmethod
     def _device_put(

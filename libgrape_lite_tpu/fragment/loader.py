@@ -103,6 +103,7 @@ def LoadGraph(
         vid_dtype=spec.vid_dtype,
         edata_dtype=spec.edata_dtype,
     )
+    frag.load_spec = spec  # preserved across rebuild-on-mutate
 
     if spec.serialize and cache:
         _serialize_fragment(frag, cache, sig)

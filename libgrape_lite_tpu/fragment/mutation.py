@@ -1,0 +1,243 @@
+"""Graph mutation: staged edits + rebuild.
+
+Re-design of the reference mutation stack:
+  * `BasicFragmentMutator` (`grape/fragment/basic_fragment_mutator.h`,
+    520 LoC) — collects per-fragment add/remove lists, shuffles to
+    owners, patches the CSR in place,
+  * `EVFragmentMutator` (`ev_fragment_mutator.h`) — parses delta
+    files: vfile ops `a oid [data]` / `d oid` / `u oid data`, efile ops
+    `a src dst [w]` / `d src dst` / `u src dst w`; for undirected
+    graphs `d`/`u` apply to both orientations
+    (`ev_fragment_mutator.h:118-127`),
+  * `LoadGraphAndMutate` (`grape/fragment/loader.h:59-68`).
+
+TPU policy: **rebuild-on-mutate.**  Device arrays are immutable XLA
+buffers with static shapes; in-place slack-capacity CSR surgery (the
+reference's `DeMutableCSR`) buys nothing under jit — mutation instead
+edits host edge arrays and rebuilds the padded shards, which also
+re-amortises capacity planning.  Edits are applied *array-level*
+(vectorised pair matching) before any device build, so a
+load-and-mutate pays for exactly one build.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+import numpy as np
+
+from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+from libgrape_lite_tpu.vertex_map.partitioner import make_partitioner
+from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+
+
+def _pair_match(src: np.ndarray, dst: np.ndarray, pairs) -> np.ndarray:
+    """Vectorised membership of (src[i], dst[i]) in `pairs` (int64-exact)."""
+    if not pairs:
+        return np.zeros(len(src), dtype=bool)
+    try:
+        import pandas as pd
+
+        idx = pd.MultiIndex.from_arrays([src, dst])
+        return idx.isin(pairs)
+    except Exception:
+        pset = set(pairs)
+        return np.fromiter(
+            ((s, d) in pset for s, d in zip(src.tolist(), dst.tolist())),
+            dtype=bool, count=len(src),
+        )
+
+
+@dataclass
+class BasicFragmentMutator:
+    """Staged mutation set (reference basic_fragment_mutator.h API)."""
+
+    add_vertices: List[int] = field(default_factory=list)
+    remove_vertices: List[int] = field(default_factory=list)
+    add_edges: List[Tuple[int, int, float]] = field(default_factory=list)
+    remove_edges: List[Tuple[int, int]] = field(default_factory=list)
+    update_edges: List[Tuple[int, int, float]] = field(default_factory=list)
+
+    def AddVertex(self, oid: int, data=None) -> None:
+        self.add_vertices.append(int(oid))
+
+    def RemoveVertex(self, oid: int) -> None:
+        self.remove_vertices.append(int(oid))
+
+    def UpdateVertex(self, oid: int, data=None) -> None:
+        pass  # vertex data is EmptyType throughout the LDBC apps
+
+    def AddEdge(self, src: int, dst: int, w: float = 0.0) -> None:
+        self.add_edges.append((int(src), int(dst), float(w)))
+
+    def RemoveEdge(self, src: int, dst: int) -> None:
+        self.remove_edges.append((int(src), int(dst)))
+
+    def UpdateEdge(self, src: int, dst: int, w: float) -> None:
+        self.update_edges.append((int(src), int(dst), float(w)))
+
+    # ---- array-level application ----
+
+    def apply_to_arrays(self, src, dst, w, oid_order):
+        """Apply staged ops to host oid edge arrays + the ordered vertex
+        universe; returns (src, dst, w, oids)."""
+        src = np.asarray(src).copy()
+        dst = np.asarray(dst).copy()
+        w = None if w is None else np.asarray(w).copy()
+
+        keep = np.ones(len(src), dtype=bool)
+        removed_v = set(self.remove_vertices)
+        if removed_v:
+            rv = np.fromiter(removed_v, dtype=np.int64)
+            keep &= ~np.isin(src, rv)
+            keep &= ~np.isin(dst, rv)
+
+        if self.remove_edges:
+            keep &= ~_pair_match(src, dst, self.remove_edges)
+
+        if self.update_edges and w is not None:
+            upd_pairs = [(s, d) for s, d, _ in self.update_edges]
+            hit = _pair_match(src, dst, upd_pairs)
+            if hit.any():
+                upd = {(s, d): x for s, d, x in self.update_edges}
+                for i in np.nonzero(hit)[0]:
+                    w[i] = upd[(int(src[i]), int(dst[i]))]
+
+        src, dst = src[keep], dst[keep]
+        if w is not None:
+            w = w[keep]
+
+        if self.add_edges:
+            # ids staged as Python ints; build int64 columns directly so
+            # oids above 2^53 never round-trip through float64
+            a_src = np.array([s for s, _, _ in self.add_edges], dtype=np.int64)
+            a_dst = np.array([d for _, d, _ in self.add_edges], dtype=np.int64)
+            src = np.concatenate([src, a_src])
+            dst = np.concatenate([dst, a_dst])
+            if w is not None:
+                a_w = np.array([x for _, _, x in self.add_edges], dtype=w.dtype)
+                w = np.concatenate([w, a_w])
+
+        # new vertex universe preserving load order (reference
+        # VertexMap::ExtendVertices appends)
+        oids = [o for o in np.asarray(oid_order).tolist() if o not in removed_v]
+        seen = set(oids)
+        for o in self.add_vertices:
+            if o not in seen:
+                oids.append(o)
+                seen.add(o)
+        return src, dst, w, np.asarray(oids, dtype=np.int64)
+
+    def mutate(self, frag: ShardedEdgecutFragment) -> ShardedEdgecutFragment:
+        """Apply staged ops and rebuild (reference MutateFragment)."""
+        if frag.edge_list is None:
+            raise ValueError(
+                "fragment was not built mutable; load with "
+                "retain_edge_list=True (LoadGraphAndMutate does this)"
+            )
+        src, dst, w = frag.edge_list
+        old_order = (
+            np.concatenate(
+                [frag.vertex_map.inner_oids(f) for f in range(frag.fnum)]
+            )
+            if frag.fnum
+            else np.zeros(0, np.int64)
+        )
+        src, dst, w, oids = self.apply_to_arrays(src, dst, w, old_order)
+        spec = getattr(frag, "load_spec", None)
+        return _build_edgecut(frag.comm_spec, oids, src, dst, w,
+                              frag.directed, spec)
+
+
+def _build_edgecut(comm_spec, oids, src, dst, w, directed, spec):
+    from libgrape_lite_tpu.fragment.loader import LoadGraphSpec
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+
+    spec = spec or LoadGraphSpec(directed=directed)
+    partitioner = make_partitioner(spec.partitioner_type, comm_spec.fnum, oids)
+    vm = VertexMap.build(oids, partitioner, idxer_type=spec.idxer_type)
+    frag = ShardedEdgecutFragment.build(
+        comm_spec, vm, src, dst, w,
+        directed=directed,
+        load_strategy=spec.load_strategy,
+        vid_dtype=spec.vid_dtype,
+        edata_dtype=spec.edata_dtype,
+        retain_edge_list=True,
+    )
+    frag.load_spec = spec
+    return frag
+
+
+def parse_delta_efile(path: str, weighted: bool, mutator: BasicFragmentMutator,
+                      directed: bool) -> None:
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line[0] == "#":
+                continue
+            parts = line.split()
+            op = parts[0]
+            if op == "a":
+                s, d = int(parts[1]), int(parts[2])
+                w = float(parts[3]) if (weighted and len(parts) > 3) else 0.0
+                mutator.AddEdge(s, d, w)
+            elif op == "d":
+                s, d = int(parts[1]), int(parts[2])
+                mutator.RemoveEdge(s, d)
+                if not directed:
+                    mutator.RemoveEdge(d, s)
+            elif op == "u":
+                s, d = int(parts[1]), int(parts[2])
+                w = float(parts[3]) if len(parts) > 3 else 0.0
+                mutator.UpdateEdge(s, d, w)
+                if not directed:
+                    mutator.UpdateEdge(d, s, w)
+
+
+def parse_delta_vfile(path: str, mutator: BasicFragmentMutator) -> None:
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line[0] == "#":
+                continue
+            parts = line.split()
+            if parts[0] == "a":
+                mutator.AddVertex(int(parts[1]))
+            elif parts[0] == "d":
+                mutator.RemoveVertex(int(parts[1]))
+            elif parts[0] == "u":
+                mutator.UpdateVertex(int(parts[1]))
+
+
+def LoadGraphAndMutate(
+    efile: str,
+    vfile: str | None,
+    delta_efile: str | None,
+    delta_vfile: str | None,
+    comm_spec: CommSpec,
+    spec=None,
+) -> ShardedEdgecutFragment:
+    """reference `LoadGraphAndMutate` (`loader.h:59-68`).  The delta is
+    applied to the parsed host arrays BEFORE the (single) device build."""
+    from libgrape_lite_tpu.fragment.loader import LoadGraphSpec
+    from libgrape_lite_tpu.io.line_parser import read_edge_file, read_vertex_file
+
+    spec = spec or LoadGraphSpec()
+
+    src, dst, w = read_edge_file(efile, weighted=spec.weighted)
+    if not spec.weighted:
+        w = None
+    if vfile:
+        oids = read_vertex_file(vfile)
+    else:
+        oids = np.unique(np.concatenate([src, dst]))
+
+    mutator = BasicFragmentMutator()
+    if delta_vfile:
+        parse_delta_vfile(delta_vfile, mutator)
+    if delta_efile:
+        parse_delta_efile(delta_efile, spec.weighted, mutator, spec.directed)
+    src, dst, w, oids = mutator.apply_to_arrays(src, dst, w, oids)
+    return _build_edgecut(comm_spec, oids, src, dst, w, spec.directed, spec)

@@ -25,6 +25,7 @@ from libgrape_lite_tpu.models.kcore import KCore
 from libgrape_lite_tpu.models.core_decomposition import CoreDecomposition
 from libgrape_lite_tpu.models.pagerank_local import PageRankLocal
 from libgrape_lite_tpu.models.kclique import KClique
+from libgrape_lite_tpu.models.pagerank_vc import PageRankVC
 from libgrape_lite_tpu.models.auto_apps import (
     BFSAuto,
     PageRankAuto,
@@ -62,4 +63,5 @@ APP_REGISTRY = {
     "core_decomposition": CoreDecomposition,
     "pagerank_local": PageRankLocal,
     "pagerank_local_parallel": PageRankLocal,
+    "pagerank_vc": PageRankVC,
 }

@@ -83,11 +83,13 @@ class BC(ParallelAppBase):
         )
 
         delta = jnp.zeros_like(state["delta"])
+        # depth/pn are fixed after the forward phase — gather once and
+        # close over them (XLA won't hoist collectives out of while_loop)
+        full_depth = ctx.gather_state(depth)
+        full_pn = ctx.gather_state(pn)
 
         def backward_round(carry):
             delta, d = carry
-            full_depth = ctx.gather_state(depth)
-            full_pn = ctx.gather_state(pn)
             full_delta = ctx.gather_state(delta)
             from_succ = jnp.logical_and(
                 ie.edge_mask, full_depth[ie.edge_nbr] == d

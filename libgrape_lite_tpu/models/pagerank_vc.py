@@ -1,0 +1,140 @@
+"""PageRankVC — PageRank on vertex-cut storage via gather-scatter.
+
+Re-design of `examples/analytical_apps/pagerank/pagerank_vc.h` +
+`GatherScatterMessageManager`
+(`grape/parallel/gather_scatter_message_manager.h:28-399`):
+
+  * degree = # of appearances as src or dst (the stored edge list is
+    the raw directed file; accumulation flows both directions,
+    `pagerank_vc.h` IncEval),
+  * per-round: every fragment scatter-adds `curr[src] -> next[dst]` and
+    `curr[dst] -> next[src]` over its edge block, partial sums are
+    gathered to masters (`GatherMasterVertices` with NumericSum) — on
+    TPU one `psum` over the frag axis,
+  * master update `(base + d·sum)/deg` (final round: `d·sum + base`),
+    then ScatterMasterVertices — free here because master state is
+    mesh-replicated.
+
+State lives in the padded 1-D gpid space of the vertex-cut chunks.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.ops as jops
+import numpy as np
+
+from libgrape_lite_tpu.app.base import GatherScatterAppBase, StepContext
+from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
+
+
+class PageRankVC(GatherScatterAppBase):
+    load_strategy = LoadStrategy.kNullLoadStrategy
+    message_strategy = MessageStrategy.kGatherScatter
+    result_format = "float"
+
+    def __init__(self, delta: float = 0.85, max_round: int = 10):
+        self.delta = delta
+        self.max_round = max_round
+
+    @property
+    def replicated_keys(self):
+        return frozenset(
+            {"rank", "deg", "vmask", "step", "dangling_sum", "total_dangling"}
+        )
+
+    def init_state(self, frag, delta: float | None = None,
+                   max_round: int | None = None):
+        if delta is not None:
+            self.delta = delta
+        if max_round is not None:
+            self.max_round = max_round
+        n_pad = frag.dev.n_pad
+        return {
+            "rank": np.zeros(n_pad, dtype=np.float64),
+            "deg": np.zeros(n_pad, dtype=np.int64),
+            "vmask": frag.vertex_mask(),
+            "step": np.int32(0),
+            "dangling_sum": np.float64(0),
+            "total_dangling": np.float64(0),
+        }
+
+    def peval(self, ctx: StepContext, frag, state):
+        n_pad = frag.n_pad
+        dt = state["rank"].dtype
+        ones = jnp.where(frag.mask, 1, 0)
+        local_deg = jops.segment_sum(
+            ones, frag.dst, num_segments=n_pad
+        ) + jops.segment_sum(ones, frag.src, num_segments=n_pad)
+        deg = ctx.sum(local_deg).astype(jnp.int64)
+
+        vmask = state["vmask"]
+        n = vmask.sum().astype(dt)
+        p = jnp.asarray(1.0, dt) / n
+        dangling = jnp.logical_and(vmask, deg == 0)
+        rank = jnp.where(
+            vmask,
+            jnp.where(deg > 0, p / jnp.maximum(deg, 1).astype(dt), p),
+            jnp.asarray(0, dt),
+        )
+        # the dangling count is over masters globally; vmask is
+        # replicated so no psum is needed (communicator.h Sum is the
+        # MPI form of the same aggregate)
+        total_dangling = dangling.sum().astype(dt)
+        state = dict(
+            state,
+            rank=rank,
+            deg=deg,
+            dangling_sum=p * total_dangling,
+            total_dangling=total_dangling,
+            step=jnp.int32(0),
+        )
+        return state, jnp.int32(1 if self.max_round > 0 else 0)
+
+    def inceval(self, ctx: StepContext, frag, state):
+        n_pad = frag.n_pad
+        rank = state["rank"]
+        dt = rank.dtype
+        vmask = state["vmask"]
+        deg = state["deg"]
+        n = vmask.sum().astype(dt)
+        d = self.delta
+
+        step = state["step"] + 1
+        base = jnp.asarray(1.0 - d, dt) / n + jnp.asarray(d, dt) * state["dangling_sum"] / n
+        dangling_sum = base * state["total_dangling"]
+
+        zero = jnp.asarray(0, dt)
+        c_src = jnp.where(frag.mask, rank[frag.src], zero)
+        c_dst = jnp.where(frag.mask, rank[frag.dst], zero)
+        partial = jops.segment_sum(
+            c_src, frag.dst, num_segments=n_pad
+        ) + jops.segment_sum(c_dst, frag.src, num_segments=n_pad)
+        gathered = ctx.sum(partial)  # GatherMasterVertices<NumericSum>
+
+        is_last = step >= jnp.int32(self.max_round)
+        iter_val = jnp.where(
+            deg > 0,
+            (base + jnp.asarray(d, dt) * gathered)
+            / jnp.maximum(deg, 1).astype(dt),
+            base,
+        )
+        final_val = gathered * jnp.asarray(d, dt) + base
+        new_rank = jnp.where(
+            vmask, jnp.where(is_last, final_val, iter_val), zero
+        )
+        state = dict(
+            state, rank=new_rank, step=step, dangling_sum=dangling_sum
+        )
+        return state, jnp.where(is_last, jnp.int32(0), jnp.int32(1))
+
+    def finalize(self, frag, state):
+        # compact the replicated gpid-space rank into [fnum, vc] rows
+        # aligned with inner_oids order (masters = diagonal fragments)
+        rank = np.asarray(state["rank"]).reshape(frag.k, frag.vc)
+        out = np.zeros((frag.fnum, frag.vc), dtype=rank.dtype)
+        for c in range(frag.k):
+            oids = frag.inner_oids(c * frag.k + c)
+            offs = oids % frag.chunk
+            out[c * frag.k + c, : len(oids)] = rank[c, offs]
+        return out

@@ -46,6 +46,9 @@ class QueryArgs:
     serialize: bool = False
     deserialize: bool = False
     serialization_prefix: str = ""
+    vc: bool = False  # vertex-cut storage (reference --vc, run_app_vc.h)
+    delta_efile: str = ""
+    delta_vfile: str = ""
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -69,6 +72,8 @@ def build_query_kwargs(app_name: str, args: QueryArgs) -> dict:
 
 def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
     name = args.application
+    if args.vc and name == "pagerank":
+        name = "pagerank_vc"  # reference run_app_vc.h:82-89
     if name not in APP_REGISTRY:
         raise ValueError(
             f"unknown application {name!r}; known: {sorted(APP_REGISTRY)}"
@@ -92,8 +97,48 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
         edata_dtype=np.float64,
     )
 
+    from libgrape_lite_tpu.utils.types import MessageStrategy
+
+    is_vc = app_cls.message_strategy == MessageStrategy.kGatherScatter
+    if args.vc and not is_vc:
+        raise ValueError(
+            f"--vc has no vertex-cut implementation for {name!r} "
+            "(the reference's --vc path supports pagerank only, "
+            "run_app_vc.h:82-89)"
+        )
+    if is_vc and (args.delta_efile or args.delta_vfile):
+        raise ValueError("--delta_efile/--delta_vfile are not supported "
+                         "with vertex-cut storage")
+
     with timer.phase("load graph"):
-        frag = LoadGraph(args.efile, args.vfile or None, comm_spec, spec)
+        if is_vc:
+            from libgrape_lite_tpu.fragment.vertexcut import (
+                ImmutableVertexcutFragment,
+            )
+            from libgrape_lite_tpu.io.line_parser import (
+                read_edge_file,
+                read_vertex_file,
+            )
+
+            src, dst, w = read_edge_file(args.efile, weighted=weighted)
+            oids = (
+                read_vertex_file(args.vfile)
+                if args.vfile
+                else np.unique(np.concatenate([src, dst]))
+            )
+            frag = ImmutableVertexcutFragment.build(
+                comm_spec, oids, src, dst, w if weighted else None
+            )
+        elif args.delta_efile or args.delta_vfile:
+            from libgrape_lite_tpu.fragment.mutation import LoadGraphAndMutate
+
+            frag = LoadGraphAndMutate(
+                args.efile, args.vfile or None,
+                args.delta_efile or None, args.delta_vfile or None,
+                comm_spec, spec,
+            )
+        else:
+            frag = LoadGraph(args.efile, args.vfile or None, comm_spec, spec)
 
     with timer.phase("load application"):
         worker = Worker(app, frag)

@@ -179,6 +179,8 @@ class Worker:
 
 
 def format_result_lines(oids, vals, fmt: str) -> str:
+    if len(oids) == 0:
+        return ""
     lines = []
     if fmt == "int":
         for o, v in zip(oids.tolist(), np.asarray(vals).tolist()):

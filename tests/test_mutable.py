@@ -1,0 +1,111 @@
+"""Mutable-fragment tests (analogue of `tests/mutable_fragment_tests.cc`
+driven by `app_tests.sh:115-167`): load p2p-31.e.mutable_base, apply
+p2p-31.e.mutable_delta, results must equal the plain p2p-31 goldens."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import dataset_path
+from tests.test_apps_golden import run_worker
+from tests.verifiers import eps_verify, exact_verify, load_golden, wcc_verify
+
+FNUMS = [1, 4]
+
+
+@pytest.fixture(scope="module")
+def mutated_cache():
+    from libgrape_lite_tpu.fragment.loader import LoadGraphSpec
+    from libgrape_lite_tpu.fragment.mutation import LoadGraphAndMutate
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+
+    cache = {}
+
+    def get(fnum):
+        if fnum not in cache:
+            spec = LoadGraphSpec(weighted=True, edata_dtype=np.float64)
+            cache[fnum] = LoadGraphAndMutate(
+                dataset_path("p2p-31.e.mutable_base"),
+                dataset_path("p2p-31.v"),
+                dataset_path("p2p-31.e.mutable_delta"),
+                None,
+                CommSpec(fnum=fnum),
+                spec,
+            )
+        return cache[fnum]
+
+    return get
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_mutable_sssp(mutated_cache, fnum):
+    from libgrape_lite_tpu.models import SSSP
+
+    res = run_worker(SSSP(), mutated_cache(fnum), source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-SSSP")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_mutable_bfs(mutated_cache, fnum):
+    from libgrape_lite_tpu.models import BFS
+
+    res = run_worker(BFS(), mutated_cache(fnum), source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-BFS")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_mutable_pagerank(mutated_cache, fnum):
+    from libgrape_lite_tpu.models import PageRank
+
+    res = run_worker(PageRank(), mutated_cache(fnum), delta=0.85, max_round=10)
+    eps_verify(res, load_golden(dataset_path("p2p-31-PR")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_mutable_wcc(mutated_cache, fnum):
+    from libgrape_lite_tpu.models import WCC
+
+    res = run_worker(WCC(), mutated_cache(fnum))
+    wcc_verify(res, load_golden(dataset_path("p2p-31-WCC")))
+
+
+def test_staged_mutator_api():
+    """MutationContext-style staged ops on a tiny graph."""
+    from libgrape_lite_tpu.fragment.mutation import BasicFragmentMutator
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.vertex_map.partitioner import MapPartitioner
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+
+    oids = np.arange(4, dtype=np.int64)
+    cs = CommSpec(fnum=2)
+    vm = VertexMap.build(oids, MapPartitioner(2, oids))
+    frag = ShardedEdgecutFragment.build(
+        cs, vm,
+        np.array([0, 1, 2]), np.array([1, 2, 3]),
+        np.array([1.0, 1.0, 10.0]),
+        directed=False, retain_edge_list=True,
+    )
+    m = BasicFragmentMutator()
+    m.AddVertex(4)
+    m.AddEdge(2, 4, 1.0)
+    m.AddEdge(4, 3, 1.0)  # shortcut 2-4-3 cheaper than 2-3 (10)
+    m.RemoveEdge(0, 1)
+    m.RemoveEdge(1, 0)
+    frag2 = m.mutate(frag)
+
+    w = Worker(SSSP(), frag2)
+    w.query(source=1)
+    oid_to_val = {}
+    vals = w.result_values()
+    for f in range(frag2.fnum):
+        for o, v in zip(
+            frag2.inner_oids(f).tolist(),
+            vals[f, : frag2.inner_vertices_num(f)].tolist(),
+        ):
+            oid_to_val[o] = v
+    assert oid_to_val[0] == np.inf  # edge removed
+    assert oid_to_val[2] == 1.0
+    assert oid_to_val[4] == 2.0  # via new vertex
+    assert oid_to_val[3] == 3.0  # via the shortcut, not the 10-edge

@@ -1,0 +1,37 @@
+"""Vertex-cut PageRank vs the PR golden (run_app_vc.h:82-89 runs
+PageRankVC on the same graph; degrees/accumulation are the undirected
+semantics, so results match p2p-31-PR)."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import dataset_path
+from tests.verifiers import eps_verify, load_golden, load_result_lines
+
+
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_pagerank_vc(fnum):
+    from libgrape_lite_tpu.fragment.vertexcut import ImmutableVertexcutFragment
+    from libgrape_lite_tpu.io.line_parser import read_edge_file, read_vertex_file
+    from libgrape_lite_tpu.models import PageRankVC
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.worker.worker import Worker, format_result_lines
+
+    src, dst, _ = read_edge_file(dataset_path("p2p-31.e"), weighted=True)
+    oids = read_vertex_file(dataset_path("p2p-31.v"))
+    frag = ImmutableVertexcutFragment.build(
+        CommSpec(fnum=fnum), oids, src, dst, None
+    )
+    app = PageRankVC()
+    w = Worker(app, frag)
+    w.query(delta=0.85, max_round=10)
+    vals = w.result_values()
+    chunks = []
+    for f in range(frag.fnum):
+        n = frag.inner_vertices_num(f)
+        if n:
+            chunks.append(
+                format_result_lines(frag.inner_oids(f), vals[f, :n], "float")
+            )
+    res = load_result_lines("".join(chunks))
+    eps_verify(res, load_golden(dataset_path("p2p-31-PR")))
