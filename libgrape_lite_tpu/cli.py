@@ -39,6 +39,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="vertex-cut (2-D) storage; fnum must be k^2")
     p.add_argument("--delta_efile", default="")
     p.add_argument("--delta_vfile", default="")
+    p.add_argument("--rebalance", action="store_true")
+    p.add_argument("--rebalance_vertex_factor", type=int, default=0)
+    p.add_argument("--memory_stats", action="store_true")
+    p.add_argument("--profile", action="store_true",
+                   help="stepwise rounds with per-round timing (PROFILING)")
     p.add_argument("--platform", default="",
                    help="jax platform override (e.g. cpu); default ambient")
     p.add_argument("--cpu_devices", type=int, default=0,

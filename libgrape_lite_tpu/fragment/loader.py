@@ -59,6 +59,8 @@ def _cache_dir(efile: str, vfile: str, spec: LoadGraphSpec, fnum: int) -> str:
             "strategy": spec.load_strategy.value,
             "partitioner": spec.partitioner_type,
             "idxer": spec.idxer_type,
+            "rebalance": spec.rebalance,
+            "rebalance_vertex_factor": spec.rebalance_vertex_factor,
             "type": "ShardedEdgecutFragment",
         },
         sort_keys=True,
@@ -93,7 +95,16 @@ def LoadGraph(
         # vertex universe = endpoints, in first-appearance order
         oids = np.unique(np.concatenate([src, dst]))
 
-    partitioner = make_partitioner(spec.partitioner_type, comm_spec.fnum, oids)
+    if spec.rebalance:
+        from libgrape_lite_tpu.fragment.rebalancer import Rebalancer
+
+        partitioner = Rebalancer(spec.rebalance_vertex_factor).partition(
+            oids, src, dst, comm_spec.fnum
+        )
+    else:
+        partitioner = make_partitioner(
+            spec.partitioner_type, comm_spec.fnum, oids
+        )
     vm = VertexMap.build(oids, partitioner, idxer_type=spec.idxer_type)
 
     frag = ShardedEdgecutFragment.build(
@@ -161,31 +172,17 @@ def _deserialize_fragment(
     all_oids = [z[f"oids_{f}"] for f in range(fnum)]
     # rebuild exact fid assignment: oids_f belongs to fragment f
     from libgrape_lite_tpu.vertex_map.idxer import make_idxer
+    from libgrape_lite_tpu.vertex_map.partitioner import ExplicitPartitioner
 
     idxers = [make_idxer(spec.idxer_type, o) for o in all_oids]
     id_parser = IdParser(fnum, vp)
-
-    class _ExplicitPartitioner:
-        type_name = "explicit"
-
-        def __init__(self, oid_lists):
-            self.fnum = len(oid_lists)
-            self._o2f = {}
-            for f, os_ in enumerate(oid_lists):
-                for o in np.asarray(os_).tolist():
-                    self._o2f[o] = f
-
-        def get_fnum(self):
-            return self.fnum
-
-        def get_partition_id(self, oids):
-            return np.fromiter(
-                (self._o2f.get(o, -1) for o in np.asarray(oids).tolist()),
-                dtype=np.int64,
-                count=len(oids),
-            )
-
-    vm = VertexMap(_ExplicitPartitioner(all_oids), idxers, id_parser)
+    flat_oids = np.concatenate(all_oids) if all_oids else np.zeros(0, np.int64)
+    flat_fids = np.concatenate(
+        [np.full(len(o), f, dtype=np.int64) for f, o in enumerate(all_oids)]
+    ) if all_oids else np.zeros(0, np.int64)
+    part = ExplicitPartitioner(flat_oids, flat_fids)
+    part.fnum = fnum
+    vm = VertexMap(part, idxers, id_parser)
 
     def csr_of(side, f):
         return CSR(

@@ -69,13 +69,27 @@ def _parse_columns(data: bytes, int_cols: int, want_cols: int):
 
 def read_vertex_file(path: str) -> np.ndarray:
     """Read a .v file; returns int64 oids (first column)."""
+    from libgrape_lite_tpu.io.native import parse_file_native
+
+    nat = parse_file_native(path, 1, False)
+    if nat is not None:
+        return nat[0]
     with open(path, "rb") as f:
         data = f.read()
     return _parse_columns(data, 1, 1)[0]
 
 
 def read_edge_file(path: str, weighted: bool):
-    """Read a .e file; returns (src_oid, dst_oid, weight|None)."""
+    """Read a .e file; returns (src_oid, dst_oid, weight|None).
+
+    Fast path: the native mmap+multithread parser (native/loader.cc,
+    the analogue of the reference's C++ partial-read loaders); fallback:
+    pandas/numpy columnar parse."""
+    from libgrape_lite_tpu.io.native import parse_file_native
+
+    nat = parse_file_native(path, 2, weighted)
+    if nat is not None:
+        return nat
     with open(path, "rb") as f:
         data = f.read()
     cols = _parse_columns(data, 2, 3 if weighted else 2)

@@ -1,0 +1,105 @@
+"""ctypes binding to the native C++ loader (native/loader.cc).
+
+The shared library is built lazily with `make -C native` on first use;
+all callers fall back to the Python/pandas parser when the toolchain or
+build is unavailable (`read_edge_file` handles the dispatch).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libgrape_tpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("GRAPE_TPU_NO_NATIVE"):
+            return None
+        if not os.path.exists(_SO_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.gl_parse.restype = ctypes.c_void_p
+        lib.gl_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.gl_num_rows.restype = ctypes.c_int64
+        lib.gl_num_rows.argtypes = [ctypes.c_void_p]
+        for name in ("gl_col0", "gl_col1"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.POINTER(ctypes.c_int64)
+            fn.argtypes = [ctypes.c_void_p]
+        lib.gl_colw.restype = ctypes.POINTER(ctypes.c_double)
+        lib.gl_colw.argtypes = [ctypes.c_void_p]
+        lib.gl_all_weighted.restype = ctypes.c_int
+        lib.gl_all_weighted.argtypes = [ctypes.c_void_p]
+        lib.gl_free.restype = None
+        lib.gl_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_file_native(path: str, ncols: int, weighted: bool):
+    """Returns (col0 int64, col1 int64 | None, w float64 | None) or None
+    when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    handle = lib.gl_parse(path.encode(), ncols, int(weighted), 0)
+    if not handle:
+        raise FileNotFoundError(path)
+    try:
+        n = lib.gl_num_rows(handle)
+        if n == 0:  # empty vectors return NULL data pointers
+            return (
+                np.zeros(0, np.int64),
+                np.zeros(0, np.int64) if ncols >= 2 else None,
+                np.zeros(0, np.float64) if weighted else None,
+            )
+        c0 = np.ctypeslib.as_array(lib.gl_col0(handle), shape=(n,)).copy()
+        c1 = (
+            np.ctypeslib.as_array(lib.gl_col1(handle), shape=(n,)).copy()
+            if ncols >= 2
+            else None
+        )
+        w = None
+        if weighted:
+            # all-rows-weighted or the file has no weight column — in the
+            # latter case behave like the python parser (w = None)
+            if lib.gl_all_weighted(handle):
+                w = np.ctypeslib.as_array(lib.gl_colw(handle), shape=(n,)).copy()
+    finally:
+        lib.gl_free(handle)
+    return c0, c1, w

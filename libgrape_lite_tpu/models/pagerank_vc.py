@@ -66,7 +66,8 @@ class PageRankVC(GatherScatterAppBase):
         local_deg = jops.segment_sum(
             ones, frag.dst, num_segments=n_pad
         ) + jops.segment_sum(ones, frag.src, num_segments=n_pad)
-        deg = ctx.sum(local_deg).astype(jnp.int64)
+        # int32 is plenty for degree counts and avoids x64-dependent dtypes
+        deg = ctx.sum(local_deg).astype(jnp.int32)
 
         vmask = state["vmask"]
         n = vmask.sum().astype(dt)

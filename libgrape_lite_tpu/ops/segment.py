@@ -16,12 +16,17 @@ import jax.numpy as jnp
 import jax.ops as jops
 
 
-def segment_reduce(values, segment_ids, num_rows: int, kind: str = "sum"):
+def segment_reduce(values, segment_ids, num_rows: int, kind: str = "sum",
+                   sorted_ids: bool = True):
     """Reduce `values` by `segment_ids` into `num_rows` rows.
 
     Ids equal to `num_rows` (padding convention) land in an overflow row
     that is sliced off — mirroring the reference's convention of routing
     invalid work to a trash slot rather than branching.
+
+    `sorted_ids` defaults True because CSR edge arrays are built sorted
+    by row (graph/csr.py) — XLA lowers sorted segment reductions to a
+    cheaper scan-style kernel than the general scatter.
     """
     fn = {
         "sum": jops.segment_sum,
@@ -29,5 +34,8 @@ def segment_reduce(values, segment_ids, num_rows: int, kind: str = "sum"):
         "max": jops.segment_max,
         "prod": jops.segment_prod,
     }[kind]
-    out = fn(values, segment_ids, num_segments=num_rows + 1)
+    out = fn(
+        values, segment_ids, num_segments=num_rows + 1,
+        indices_are_sorted=sorted_ids,
+    )
     return out[:num_rows]

@@ -43,6 +43,10 @@ class QueryArgs:
     fnum: int | None = None
     partitioner_type: str = "map"
     idxer_type: str = "hashmap"
+    rebalance: bool = False
+    rebalance_vertex_factor: int = 0
+    memory_stats: bool = False
+    profile: bool = False
     serialize: bool = False
     deserialize: bool = False
     serialization_prefix: str = ""
@@ -91,6 +95,8 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
         load_strategy=app_cls.load_strategy,
         partitioner_type=args.partitioner_type,
         idxer_type=args.idxer_type,
+        rebalance=args.rebalance,
+        rebalance_vertex_factor=args.rebalance_vertex_factor,
         serialize=args.serialize,
         deserialize=args.deserialize,
         serialization_prefix=args.serialization_prefix,
@@ -140,11 +146,29 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
         else:
             frag = LoadGraph(args.efile, args.vfile or None, comm_spec, spec)
 
+    if args.memory_stats:
+        from libgrape_lite_tpu.utils.memory import get_memory_stats
+
+        print(f"[memory] after load: {get_memory_stats()}")
+
     with timer.phase("load application"):
         worker = Worker(app, frag)
 
     with timer.phase("run algorithm"):
-        worker.query(**build_query_kwargs(name, args))
+        kw = build_query_kwargs(name, args)
+        if args.profile and not getattr(app, "host_only", False):
+            from libgrape_lite_tpu.utils import logging as glog
+
+            if glog._level < 1:
+                glog.set_vlog_level(1)  # --profile exists to show timings
+            worker.query_stepwise(**kw)
+        else:
+            worker.query(**kw)
+
+    if args.memory_stats:
+        from libgrape_lite_tpu.utils.memory import get_memory_stats
+
+        print(f"[memory] after query: {get_memory_stats()}")
 
     if args.out_prefix:
         with timer.phase("print output"):

@@ -1,0 +1,26 @@
+"""glog-style leveled logging (reference uses glog VLOG throughout,
+e.g. `grape/worker/worker.h:120-139`).  Level via GRAPE_TPU_VLOG
+(default 0 = silent) or `set_vlog_level`."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_level = int(os.environ.get("GRAPE_TPU_VLOG", "0"))
+
+
+def set_vlog_level(level: int) -> None:
+    global _level
+    _level = level
+
+
+def vlog(level: int, msg: str) -> None:
+    if level <= _level:
+        ts = time.strftime("%H:%M:%S")
+        print(f"[grape-tpu {ts}] {msg}", file=sys.stderr)
+
+
+def log_info(msg: str) -> None:
+    print(f"[grape-tpu] {msg}", file=sys.stderr)

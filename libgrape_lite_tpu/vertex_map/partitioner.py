@@ -90,6 +90,27 @@ class SegmentedPartitioner(PartitionerBase):
         )
 
 
+class ExplicitPartitioner(PartitionerBase):
+    """Arbitrary precomputed oid->fid assignment, vectorised via binary
+    search over the sorted oid table.  Shared by the rebalancer and the
+    deserialization path (any partitioner is reconstructible as one)."""
+
+    type_name = "explicit"
+
+    def __init__(self, oids: np.ndarray, fids: np.ndarray):
+        self.fnum = int(np.asarray(fids).max()) + 1 if len(fids) else 1
+        order = np.argsort(oids, kind="stable")
+        self._sorted_oids = np.asarray(oids)[order]
+        self._sorted_fids = np.asarray(fids)[order]
+
+    def get_partition_id(self, oids: np.ndarray) -> np.ndarray:
+        q = np.asarray(oids)
+        pos = np.searchsorted(self._sorted_oids, q)
+        pos_c = np.clip(pos, 0, len(self._sorted_oids) - 1)
+        ok = self._sorted_oids[pos_c] == q
+        return np.where(ok, self._sorted_fids[pos_c], -1).astype(np.int64)
+
+
 class VCPartitioner(PartitionerBase):
     """2-D vertex-cut partitioner (reference `partitioner.h:269-330`):
     requires fnum = k^2; edge (src, dst) lands on fragment

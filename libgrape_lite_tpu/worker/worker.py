@@ -134,23 +134,94 @@ class Worker:
             self.rounds = 0
             return self._result_state
 
-        state_np = app.init_state(frag, **query_args)
-        # place state: sharded leaves over frag axis, the rest replicated
-        shard = self.comm_spec.sharded()
-        repl = self.comm_spec.replicated()
-        state = {
-            k: jax.device_put(
-                jnp.asarray(v), repl if k in app.replicated_keys else shard
-            )
-            for k, v in state_np.items()
-        }
-
+        state = self._place_state(app.init_state(frag, **query_args))
         runner = self._runner_for(mr, state)
         out_state, rounds = runner(frag.dev, state)
         out_state = jax.block_until_ready(out_state)
         self.rounds = int(rounds)
         self._result_state = out_state
         return out_state
+
+    def _place_state(self, state_np):
+        """device_put the init state: sharded leaves over the frag axis,
+        declared-replicated leaves everywhere."""
+        shard = self.comm_spec.sharded()
+        repl = self.comm_spec.replicated()
+        return {
+            k: jax.device_put(
+                jnp.asarray(v),
+                repl if k in self.app.replicated_keys else shard,
+            )
+            for k, v in state_np.items()
+        }
+
+    def _compile_single_step(self, kind: str, state):
+        """One jitted (PEval | IncEval) superstep — the unfused building
+        block shared by query_stepwise; `query` fuses the whole loop via
+        _make_runner instead."""
+        app = self.app
+        replicated = set(app.replicated_keys)
+        specs = {
+            k: (P() if k in replicated else P(FRAG_AXIS)) for k in state
+        }
+
+        def fn(frag_stacked, st):
+            lf = frag_stacked.local()
+            s = _squeeze_state(st, replicated)
+            from libgrape_lite_tpu.app.base import StepContext
+
+            ctx = StepContext()
+            s2, active = (
+                app.peval(ctx, lf, s) if kind == "peval"
+                else app.inceval(ctx, lf, s)
+            )
+            return _unsqueeze_state(s2, replicated), jnp.int32(active)
+
+        return jax.jit(
+            jax.shard_map(
+                fn, mesh=self.comm_spec.mesh, in_specs=(P(FRAG_AXIS), specs),
+                out_specs=(specs, P()), check_vma=False,
+            )
+        )
+
+    def query_stepwise(self, max_rounds: int | None = None, **query_args):
+        """PROFILING-mode query: drive rounds from the host, one jitted
+        superstep per round, logging per-round wall time and the
+        termination vote — the observable behavior of the reference's
+        coordinator logs (`worker.h:120-139`) and -DPROFILING timers.
+        Slower than `query` (host sync per round); results identical."""
+        import time
+
+        from libgrape_lite_tpu.utils import logging as glog
+
+        app = self.app
+        frag = self.fragment
+        if getattr(app, "host_only", False):
+            return self.query(max_rounds, **query_args)
+        mr = app.max_rounds if max_rounds is None else max_rounds
+        if mr <= 0:
+            mr = _INT32_MAX
+
+        state = self._place_state(app.init_state(frag, **query_args))
+        peval_fn = self._compile_single_step("peval", state)
+        inc_fn = self._compile_single_step("inceval", state)
+
+        t0 = time.perf_counter()
+        state, active = jax.block_until_ready(peval_fn(frag.dev, state))
+        glog.vlog(1, f"PEval: {time.perf_counter() - t0:.6f}s active={int(active)}")
+        rounds = 0
+        while int(active) > 0 and rounds < mr:
+            t0 = time.perf_counter()
+            state, active = jax.block_until_ready(inc_fn(frag.dev, state))
+            rounds += 1
+            glog.vlog(
+                1,
+                f"IncEval round {rounds}: {time.perf_counter() - t0:.6f}s "
+                f"active={int(active)}",
+            )
+        self.rounds = rounds
+        self._result_state = state
+        return state
 
     # ---- Output / Assemble (reference worker.h:148-154, ctx.Output) ----
 

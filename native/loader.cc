@@ -1,0 +1,195 @@
+// Native graph-file loader.
+//
+// Re-design of the reference's native IO stack
+// (grape/io/local_io_adaptor.{h,cc} + grape/io/tsv_line_parser.h +
+// the partial-read parsing loops of
+// grape/fragment/basic_fragment_loader_base.h): mmap the file, split
+// it into per-thread byte ranges aligned to line boundaries (the
+// SetPartialRead pattern, local_io_adaptor.h:49), and parse
+// whitespace-separated integer/float columns with branch-light custom
+// scanners.  Exposed through a C ABI consumed via ctypes — no pybind11
+// dependency.
+//
+// Build: `make -C native` produces libgrape_tpu_native.so.
+
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Chunk {
+  const char* begin;
+  const char* end;
+  std::vector<int64_t> c0, c1;
+  std::vector<double> c2;
+  int64_t weight_tokens = 0;  // rows that actually had a weight column
+};
+
+inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+inline const char* parse_i64(const char* p, const char* end, int64_t* out) {
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) neg = (*p++ == '-');
+  int64_t v = 0;
+  while (p < end && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+  *out = neg ? -v : v;
+  return p;
+}
+
+inline const char* parse_f64(const char* p, const char* end, double* out) {
+  char buf[64];
+  int n = 0;
+  while (p < end && n < 63 && *p != ' ' && *p != '\t' && *p != '\n' &&
+         *p != '\r')
+    buf[n++] = *p++;
+  buf[n] = 0;
+  *out = strtod(buf, nullptr);
+  return p;
+}
+
+void parse_chunk(Chunk* ch, int ncols, int weighted) {
+  const char* p = ch->begin;
+  const char* end = ch->end;
+  while (p < end) {
+    p = skip_ws(p, end);
+    if (p >= end) break;
+    if (*p == '#' || *p == '\n') {  // comment or blank line
+      while (p < end && *p != '\n') ++p;
+      if (p < end) ++p;
+      continue;
+    }
+    int64_t a = 0, b = 0;
+    double w = 0.0;
+    p = parse_i64(p, end, &a);
+    if (ncols >= 2) {
+      p = skip_ws(p, end);
+      p = parse_i64(p, end, &b);
+    }
+    if (weighted) {
+      p = skip_ws(p, end);
+      if (p < end && *p != '\n') {
+        p = parse_f64(p, end, &w);
+        ++ch->weight_tokens;
+      }
+    }
+    ch->c0.push_back(a);
+    if (ncols >= 2) ch->c1.push_back(b);
+    if (weighted) ch->c2.push_back(w);
+    while (p < end && *p != '\n') ++p;
+    if (p < end) ++p;
+  }
+}
+
+struct Parsed {
+  std::vector<int64_t> c0, c1;
+  std::vector<double> c2;
+  int64_t weight_tokens = 0;
+};
+
+// Parse `path` into columns. ncols: 1 = vertex file (oid only),
+// 2 = unweighted edges. weighted adds a trailing double column.
+Parsed* parse_file(const char* path, int ncols, int weighted, int nthreads) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0) {
+    close(fd);
+    auto* out = new Parsed();
+    return out;  // empty file -> empty columns
+  }
+  size_t size = static_cast<size_t>(st.st_size);
+  const char* data =
+      static_cast<const char*>(mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0));
+  close(fd);
+  if (data == MAP_FAILED) return nullptr;
+
+  if (nthreads <= 0) {
+    nthreads = static_cast<int>(std::thread::hardware_concurrency());
+    if (nthreads < 1) nthreads = 1;
+  }
+  if (size < (1u << 20)) nthreads = 1;
+
+  // byte ranges aligned to line boundaries (SetPartialRead pattern)
+  std::vector<Chunk> chunks(nthreads);
+  size_t per = size / nthreads;
+  size_t start = 0;
+  for (int t = 0; t < nthreads; ++t) {
+    size_t end = (t == nthreads - 1) ? size : per * (t + 1);
+    if (end < size) {
+      while (end < size && data[end] != '\n') ++end;
+      if (end < size) ++end;
+    }
+    if (end < start) end = start;
+    chunks[t].begin = data + start;
+    chunks[t].end = data + end;
+    start = end;
+  }
+
+  std::vector<std::thread> threads;
+  threads.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t)
+    threads.emplace_back(parse_chunk, &chunks[t], ncols, weighted);
+  for (auto& th : threads) th.join();
+
+  auto* out = new Parsed();
+  size_t total = 0;
+  for (auto& ch : chunks) total += ch.c0.size();
+  out->c0.reserve(total);
+  if (ncols >= 2) out->c1.reserve(total);
+  if (weighted) out->c2.reserve(total);
+  for (auto& ch : chunks) {
+    out->c0.insert(out->c0.end(), ch.c0.begin(), ch.c0.end());
+    out->c1.insert(out->c1.end(), ch.c1.begin(), ch.c1.end());
+    out->c2.insert(out->c2.end(), ch.c2.begin(), ch.c2.end());
+    out->weight_tokens += ch.weight_tokens;
+  }
+  munmap(const_cast<char*>(data), size);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* gl_parse(const char* path, int ncols, int weighted, int nthreads) {
+  return parse_file(path, ncols, weighted, nthreads);
+}
+
+int64_t gl_num_rows(void* handle) {
+  return static_cast<Parsed*>(handle)->c0.size();
+}
+
+const int64_t* gl_col0(void* handle) {
+  return static_cast<Parsed*>(handle)->c0.data();
+}
+
+const int64_t* gl_col1(void* handle) {
+  return static_cast<Parsed*>(handle)->c1.data();
+}
+
+const double* gl_colw(void* handle) {
+  return static_cast<Parsed*>(handle)->c2.data();
+}
+
+// 1 when every parsed row carried a weight token (callers treat a
+// weightless file like the python parser's w=None)
+int gl_all_weighted(void* handle) {
+  auto* p = static_cast<Parsed*>(handle);
+  return !p->c0.empty() &&
+         p->weight_tokens == static_cast<int64_t>(p->c0.size());
+}
+
+void gl_free(void* handle) { delete static_cast<Parsed*>(handle); }
+
+}  // extern "C"

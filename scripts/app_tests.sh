@@ -1,0 +1,75 @@
+#!/bin/bash -e
+# End-to-end CLI test harness — the analogue of the reference's
+# misc/app_tests.sh: every app via the real CLI at several fragment
+# counts, outputs verified against dataset/p2p-31-* goldens.
+# (pytest tests/ covers the same matrix in-process; this script drives
+# the user-facing surface.)
+
+REPO="$( cd "$(dirname "$0")/.." >/dev/null 2>&1 ; pwd -P )"
+cd "$REPO"
+OUT=$(mktemp -d)
+trap 'rm -rf "$OUT"' EXIT
+
+PLATFORM_ARGS="--platform cpu --cpu_devices 8"
+DS="$REPO/dataset"
+
+run() {
+  local np=$1; shift
+  local app=$1; shift
+  rm -rf "$OUT/res"
+  python -m libgrape_lite_tpu.cli --application "$app" \
+    --efile "$DS/p2p-31.e" --vfile "$DS/p2p-31.v" \
+    --out_prefix "$OUT/res" $PLATFORM_ARGS --fnum "$np" "$@" >/dev/null
+  cat "$OUT/res"/* | sort -k1n > "$OUT/merged.res"
+}
+
+verify() {  # verify <kind:exact|eps|wcc> <golden>
+  python - "$1" "$DS/$2" "$OUT/merged.res" <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from tests.verifiers import (load_golden, load_result_lines,
+                             exact_verify, eps_verify, wcc_verify)
+kind, golden_path, res_path = sys.argv[1:4]
+res = load_result_lines(open(res_path).read())
+gold = load_golden(golden_path)
+{"exact": exact_verify, "eps": eps_verify, "wcc": wcc_verify}[kind](res, gold)
+print(f"  OK ({kind}, {len(res)} vertices)")
+EOF
+}
+
+for np in 1 2 4 8; do
+  echo "== fnum=$np =="
+  echo "sssp";          run $np sssp --sssp_source=6;        verify exact p2p-31-SSSP
+  echo "sssp_auto";     run $np sssp_auto --sssp_source=6;   verify exact p2p-31-SSSP
+  echo "bfs";           run $np bfs --bfs_source=6;          verify exact p2p-31-BFS
+  echo "pagerank";      run $np pagerank --pr_mr=10;         verify eps p2p-31-PR
+  echo "cdlp";          run $np cdlp --cdlp_mr=10;           verify exact p2p-31-CDLP
+  echo "wcc";           run $np wcc;                         verify wcc p2p-31-WCC
+done
+
+echo "== directed (fnum=4) =="
+echo "sssp --directed"; run 4 sssp --sssp_source=6 --directed; verify exact p2p-31-SSSP-directed
+echo "bfs --directed";  run 4 bfs --bfs_source=6 --directed;   verify exact p2p-31-BFS-directed
+echo "pagerank --directed"; run 4 pagerank --pr_mr=10 --directed; verify eps p2p-31-PR-directed
+
+echo "== lcc (fnum=4) =="
+run 4 lcc; verify eps p2p-31-LCC
+
+echo "== vertex-cut pagerank (fnum=4) =="
+run 4 pagerank --vc --pr_mr=10; verify eps p2p-31-PR
+
+echo "== mutation (fnum=4) =="
+rm -rf "$OUT/res"
+python -m libgrape_lite_tpu.cli --application sssp \
+  --efile "$DS/p2p-31.e.mutable_base" --vfile "$DS/p2p-31.v" \
+  --delta_efile "$DS/p2p-31.e.mutable_delta" --sssp_source=6 \
+  --out_prefix "$OUT/res" $PLATFORM_ARGS --fnum 4 >/dev/null
+cat "$OUT/res"/* | sort -k1n > "$OUT/merged.res"
+verify exact p2p-31-SSSP
+
+echo "== serialization roundtrip (fnum=2) =="
+SER="$OUT/serial"
+run 2 pagerank --pr_mr=10 --serialize --serialization_prefix "$SER"; verify eps p2p-31-PR
+run 2 pagerank --pr_mr=10 --deserialize --serialization_prefix "$SER"; verify eps p2p-31-PR
+
+echo "ALL APP TESTS PASSED"

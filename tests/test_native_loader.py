@@ -1,0 +1,55 @@
+"""Native C++ loader vs Python parser parity."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import dataset_path
+
+
+def test_native_parser_parity(tmp_path):
+    from libgrape_lite_tpu.io.native import available, parse_file_native
+    from libgrape_lite_tpu.io.line_parser import _parse_columns
+
+    if not available():
+        pytest.skip("native toolchain unavailable")
+
+    src, dst, w = parse_file_native(dataset_path("p2p-31.e"), 2, True)
+    with open(dataset_path("p2p-31.e"), "rb") as f:
+        cols = _parse_columns(f.read(), 2, 3)
+    assert np.array_equal(src, cols[0])
+    assert np.array_equal(dst, cols[1])
+    assert np.allclose(w, cols[2])
+
+    oids = parse_file_native(dataset_path("p2p-31.v"), 1, False)[0]
+    with open(dataset_path("p2p-31.v"), "rb") as f:
+        vcols = _parse_columns(f.read(), 1, 1)
+    assert np.array_equal(oids, vcols[0])
+
+
+def test_native_parser_edge_cases(tmp_path):
+    from libgrape_lite_tpu.io.native import available, parse_file_native
+
+    if not available():
+        pytest.skip("native toolchain unavailable")
+
+    p = tmp_path / "t.e"
+    p.write_text(
+        "# comment line\n"
+        "1 2 0.5\n"
+        "\n"
+        "9007199254740993 4 1.25\n"  # 2^53+1: must stay int64-exact
+        "-3 7 2.0\n"
+    )
+    src, dst, w = parse_file_native(str(p), 2, True)
+    assert src.tolist() == [1, 9007199254740993, -3]
+    assert dst.tolist() == [2, 4, 7]
+    assert w.tolist() == [0.5, 1.25, 2.0]
+
+
+def test_native_parser_missing_file(tmp_path):
+    from libgrape_lite_tpu.io.native import available, parse_file_native
+
+    if not available():
+        pytest.skip("native toolchain unavailable")
+    with pytest.raises(FileNotFoundError):
+        parse_file_native(str(tmp_path / "nope.e"), 2, True)
