@@ -26,6 +26,7 @@ from libgrape_lite_tpu.models.core_decomposition import CoreDecomposition
 from libgrape_lite_tpu.models.pagerank_local import PageRankLocal
 from libgrape_lite_tpu.models.kclique import KClique
 from libgrape_lite_tpu.models.pagerank_vc import PageRankVC
+from libgrape_lite_tpu.models.lcc_directed import LCCDirected
 from libgrape_lite_tpu.models.auto_apps import (
     BFSAuto,
     PageRankAuto,
@@ -57,6 +58,10 @@ APP_REGISTRY = {
     "lcc_auto": LCC,
     "lcc_opt": LCC,
     "lcc_beta": LCC,
+    "lcc_directed": LCCDirected,
+    # pagerank already pulls over in-edges (pagerank_parallel.h
+    # semantics), which is the directed-correct formulation
+    "pagerank_directed": PageRank,
     "bc": BC,
     "kcore": KCore,
     "kclique": KClique,

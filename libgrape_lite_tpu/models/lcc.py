@@ -69,14 +69,12 @@ class LCC(ParallelAppBase):
 
     @staticmethod
     def _build_bitmap(rows, cols, keep, vp, words):
-        """Packed scatter: bit `cols[i]` of row `rows[i]` for kept edges.
-        Kept (row, col) pairs are unique, so bit-add == bit-or."""
-        r = jnp.where(keep, rows, jnp.int32(vp))  # trash row
-        word = (cols >> 5).astype(jnp.int32)
-        bit = jnp.uint32(1) << (cols & 31).astype(jnp.uint32)
-        bm = jnp.zeros((vp + 1, words), dtype=jnp.uint32)
-        bm = bm.at[r, word].add(jnp.where(keep, bit, jnp.uint32(0)))
-        return bm[:vp]
+        """Packed adjacency bitmap — delegates to the shared
+        utils/bitset.pack_bits (kept (row, col) pairs must be unique so
+        bit-add == bit-or)."""
+        from libgrape_lite_tpu.utils.bitset import pack_bits
+
+        return pack_bits(cols, keep, vp, rows, words * 32)
 
     # ---- the staged computation ---------------------------------------
 

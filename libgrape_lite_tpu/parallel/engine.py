@@ -1,0 +1,55 @@
+"""The TPU "parallel engine": how ForEach maps to XLA.
+
+Re-design of the reference's two execution engines:
+
+* CPU `ParallelEngine` (`grape/parallel/parallel_engine.h:32-719`):
+  thread-pool `ForEach` over ranges / vertex sets with chunked
+  work-stealing.
+* CUDA `ParallelEngine` (`grape/cuda/parallel/parallel_engine.h:42-1444`):
+  the load-balancing kernel catalog `{none, cm, cmold, wm, cta,
+  strict}` that assigns edges to threads/warps/CTAs to fight degree
+  skew.
+
+On TPU, both collapse into data layout decisions rather than scheduling
+code, which is what this module provides:
+
+* `ForEach(vertices)`  -> elementwise ops over `[vp]` state rows (VPU
+  lanes are the "threads"; masking replaces range splitting).
+* `ForEach(frontier)`  -> the same ops under a boolean mask — XLA fuses
+  mask + compute, so an empty frontier costs memory bandwidth, not
+  branches (the dense-frontier tradeoff of `DenseVertexSet`).
+* `ForEachEdge(lb=*)`  -> edge-major arrays + `segment_reduce`.  Every
+  edge is one lane of work keyed by its row id; XLA tiles the sorted
+  segment reduction evenly, which is precisely what the reference's
+  `strict` policy (exact edge partitioning via binary search,
+  `parallel_engine.h:847+`) does in software.  The cm/wm/cta policies
+  exist because CUDA kernels must choose a granularity; a TPU segment
+  reduction has no such choice to make.
+
+`edge_balanced_tiles` below is the one scheduling primitive the
+kernels do need: an exact edge partitioning of a CSR into fixed-size
+tiles with per-tile row spans (the `strict` analogue), used by chunked
+Pallas kernels to bound VMEM working sets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def edge_balanced_tiles(indptr: np.ndarray, tile_edges: int):
+    """Exact edge partitioning (reference LBSTRICT,
+    `cuda/parallel/parallel_engine.h:847+`): tile t covers edges
+    [t*tile_edges, (t+1)*tile_edges) and rows [row_lo[t], row_hi[t]].
+
+    Returns (row_lo, row_hi) int32 arrays of length num_tiles; rows
+    spanning a tile boundary appear in both tiles (callers combine
+    partial sums, which segment reductions do for free).
+    """
+    total = int(indptr[-1])
+    num_tiles = max(1, -(-total // tile_edges))
+    starts = np.arange(num_tiles, dtype=np.int64) * tile_edges
+    ends = np.minimum(starts + tile_edges, total)
+    row_lo = np.searchsorted(indptr, starts, side="right") - 1
+    row_hi = np.searchsorted(indptr, ends, side="left")
+    return row_lo.astype(np.int32), np.maximum(row_hi, row_lo + 1).astype(np.int32)

@@ -1,0 +1,91 @@
+"""Append-only streaming fragment.
+
+Re-design of `examples/gnn_sampler/append_only_edgecut_fragment.h`
+(1029 LoC): a fragment that absorbs streaming edge inserts cheaply and
+serves adjacency queries.  The reference chains per-vertex extra-edge
+blocks; here inserts accumulate in a host spill buffer and the padded
+device CSR is rebuilt when the buffer crosses a threshold (amortised
+O(E) — the TPU analogue of block chaining, since XLA buffers are
+immutable anyway).  `device_csr()` hands out the current snapshot for
+jitted samplers.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class AppendOnlyEdgecutFragment:
+    def __init__(self, n: int, src: np.ndarray, dst: np.ndarray,
+                 w: np.ndarray | None = None, rebuild_threshold: float = 0.25):
+        self.n = n
+        self._src = np.asarray(src, dtype=np.int64)
+        self._dst = np.asarray(dst, dtype=np.int64)
+        self._w = None if w is None else np.asarray(w, dtype=np.float32)
+        self._pending: list[tuple[int, int, float]] = []
+        self.rebuild_threshold = rebuild_threshold
+        self._snapshot = None
+        self._build()
+
+    # ---- streaming ingest (reference AddEdges path) ----
+
+    def extend(self, src, dst, w=None) -> None:
+        src = np.asarray(src).tolist()
+        dst = np.asarray(dst).tolist()
+        ws = (
+            np.asarray(w).tolist()
+            if w is not None
+            else [1.0] * len(src)
+        )
+        if w is not None and self._w is None:
+            # weights arrive on a previously unweighted stream: backfill
+            # existing edges with weight 1 so nothing is dropped
+            self._w = np.ones(len(self._src), dtype=np.float32)
+        self._pending.extend(zip(src, dst, ws))
+        if len(self._pending) > self.rebuild_threshold * max(len(self._src), 1):
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        # ids stay int64 end to end (no float64 round-trip)
+        a_src = np.array([s for s, _, _ in self._pending], dtype=np.int64)
+        a_dst = np.array([d for _, d, _ in self._pending], dtype=np.int64)
+        a_w = np.array([x for _, _, x in self._pending], dtype=np.float32)
+        self._src = np.concatenate([self._src, a_src])
+        self._dst = np.concatenate([self._dst, a_dst])
+        if self._w is not None:
+            self._w = np.concatenate([self._w, a_w])
+        self.n = max(self.n, int(self._src.max(initial=self.n - 1)) + 1,
+                     int(self._dst.max(initial=self.n - 1)) + 1)
+        self._pending.clear()
+        self._build()
+
+    def _build(self) -> None:
+        order = np.lexsort((self._dst, self._src))
+        src = self._src[order]
+        dst = self._dst[order]
+        counts = np.bincount(src, minlength=self.n)
+        indptr = np.zeros(self.n + 1, dtype=np.int32)
+        np.cumsum(counts, out=indptr[1:])
+        self._snapshot = {
+            "indptr": jnp.asarray(indptr),
+            "nbr": jnp.asarray(dst.astype(np.int32)),
+            "w": (
+                jnp.asarray(self._w[order])
+                if self._w is not None
+                else None
+            ),
+        }
+
+    # ---- queries ----
+
+    @property
+    def num_edges(self) -> int:
+        return len(self._src) + len(self._pending)
+
+    def device_csr(self):
+        """(indptr [n+1], nbr [E], w [E] | None) — includes flushed
+        edges only; call flush() for an exact snapshot."""
+        return self._snapshot["indptr"], self._snapshot["nbr"], self._snapshot["w"]

@@ -1,0 +1,116 @@
+"""Streaming sources/sinks for the sampler pipeline.
+
+Re-design of `examples/gnn_sampler/kafka_{consumer,producer}.h` +
+`run_sampler.cc`: the reference consumes graph-update and query streams
+from Kafka and emits sampled neighborhoods back.  Kafka clients are not
+part of this image, so the transport is pluggable: `FileSource` /
+`FileSink` replay and record the same line protocol
+(`e src dst [w]` updates, `q vid` queries), and `KafkaSource/KafkaSink`
+bind to confluent_kafka when it is importable.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+
+class FileSource:
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self) -> Iterator[str]:
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if line and line[0] != "#":
+                    yield line
+
+
+class FileSink:
+    def __init__(self, path: str):
+        self._f = open(path, "w")
+
+    def emit(self, line: str) -> None:
+        self._f.write(line + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def kafka_available() -> bool:
+    try:
+        import confluent_kafka  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+class KafkaSource:  # pragma: no cover - requires kafka runtime
+    def __init__(self, brokers: str, topic: str, group: str = "grape-tpu"):
+        from confluent_kafka import Consumer
+
+        self._c = Consumer(
+            {"bootstrap.servers": brokers, "group.id": group,
+             "auto.offset.reset": "earliest"}
+        )
+        self._c.subscribe([topic])
+
+    def __iter__(self):
+        while True:
+            msg = self._c.poll(1.0)
+            if msg is None or msg.error():
+                continue
+            yield msg.value().decode()
+
+
+class KafkaSink:  # pragma: no cover - requires kafka runtime
+    def __init__(self, brokers: str, topic: str):
+        from confluent_kafka import Producer
+
+        self._p = Producer({"bootstrap.servers": brokers})
+        self._topic = topic
+
+    def emit(self, line: str) -> None:
+        self._p.produce(self._topic, line.encode())
+
+    def close(self) -> None:
+        self._p.flush()
+
+
+def run_pipeline(fragment, sampler, source: Iterable[str], sink,
+                 fanouts=(10, 5), batch: int = 512) -> int:
+    """The run_sampler.cc loop: drain updates/queries, extend the
+    append-only fragment, batch-sample, emit `vid: n1 n2 ...` lines."""
+    import numpy as np
+
+    queries: list[int] = []
+    emitted = 0
+
+    def flush_queries():
+        nonlocal emitted
+        if not queries:
+            return
+        fragment.flush()
+        hops = sampler.sample(np.asarray(queries), fanouts)
+        for i, q in enumerate(queries):
+            flat = [str(x) for h in hops for x in h[i].tolist() if x >= 0]
+            sink.emit(f"{q}: {' '.join(flat)}")
+            emitted += 1
+        queries.clear()
+
+    for line in source:
+        parts = line.split()
+        if not parts:
+            continue
+        if parts[0] == "e":
+            fragment.extend(
+                [int(parts[1])], [int(parts[2])],
+                [float(parts[3])] if len(parts) > 3 else None,
+            )
+        elif parts[0] == "q":
+            queries.append(int(parts[1]))
+            if len(queries) >= batch:
+                flush_queries()
+    flush_queries()
+    return emitted

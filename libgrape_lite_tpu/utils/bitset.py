@@ -1,0 +1,69 @@
+"""Packed bitsets.
+
+Re-design of `grape/utils/bitset.h:41-412` (64-bit-word bitset with
+atomic set/reset + parallel count) and the device bitmaps of
+`grape/cuda/utils/bitset.h`.  Two forms:
+
+* `Bitset` — host numpy uint64 words (loaders, tests),
+* jnp helpers (`pack_bits`, `unpack_bits`, `popcount_rows`) for traced
+  code; "atomic" set degenerates to scatter-or / unique-bit scatter-add
+  because XLA scatters are race-free by construction.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class Bitset:
+    def __init__(self, size: int):
+        self.size = size
+        self.words = np.zeros((size + 63) // 64, dtype=np.uint64)
+
+    def set_bit(self, i) -> None:
+        i = np.asarray(i)
+        np.bitwise_or.at(
+            self.words, i // 64, np.uint64(1) << (i % 64).astype(np.uint64)
+        )
+
+    def reset_bit(self, i) -> None:
+        i = np.asarray(i)
+        mask = np.uint64(1) << (i % 64).astype(np.uint64)
+        # two-pass: collect per-word masks then AND-NOT
+        acc = np.zeros_like(self.words)
+        np.bitwise_or.at(acc, i // 64, mask)
+        self.words &= ~acc
+
+    def get_bit(self, i):
+        i = np.asarray(i)
+        return (self.words[i // 64] >> (i % 64).astype(np.uint64)) & np.uint64(1) != 0
+
+    def count(self) -> int:
+        if hasattr(np, "bitwise_count"):
+            return int(np.bitwise_count(self.words).sum())
+        return int(sum(bin(int(w)).count("1") for w in self.words))
+
+    def clear(self) -> None:
+        self.words[:] = 0
+
+
+# ---- traced (jnp) helpers ----
+
+def pack_bits(indices, keep, num_rows: int, rows, num_bits: int):
+    """Scatter bit `indices[i]` into row `rows[i]` for kept entries;
+    (row, index) pairs must be unique so add == or.  Returns
+    [num_rows, ceil(num_bits/32)] uint32."""
+    words = (num_bits + 31) // 32
+    r = jnp.where(keep, rows, jnp.int32(num_rows))
+    word = (indices >> 5).astype(jnp.int32)
+    bit = jnp.uint32(1) << (indices & 31).astype(jnp.uint32)
+    bm = jnp.zeros((num_rows + 1, words), dtype=jnp.uint32)
+    bm = bm.at[r, word].add(jnp.where(keep, bit, jnp.uint32(0)))
+    return bm[:num_rows]
+
+
+def popcount_rows(bm) -> jnp.ndarray:
+    """Row-wise population count of packed uint32 bitmaps."""
+    return lax.population_count(bm).sum(axis=-1, dtype=jnp.int32)

@@ -1,0 +1,62 @@
+"""Host thread pool.
+
+Re-design of `grape/utils/thread_pool.h:53-125` + `BlockingQueue`
+(`grape/utils/concurrent_queue.h`): futures-based pool for host-side
+work (parallel file parsing, per-fragment CSR builds).  Device-side
+parallelism needs no pool — XLA owns it; the reference's CPU-affinity
+option maps to nothing useful under a single-controller runtime and is
+accepted but ignored.
+"""
+
+from __future__ import annotations
+
+import queue
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Iterable
+
+
+class ThreadPool:
+    def __init__(self, num_threads: int | None = None, affinity=None):
+        self._pool = ThreadPoolExecutor(max_workers=num_threads)
+        self.num_threads = self._pool._max_workers
+
+    def enqueue(self, fn: Callable, *args, **kwargs) -> Future:
+        return self._pool.submit(fn, *args, **kwargs)
+
+    def for_each(self, fn: Callable, items: Iterable):
+        return list(self._pool.map(fn, items))
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=True)
+
+
+class BlockingQueue:
+    """Producer-count-aware MPMC queue (reference concurrent_queue.h):
+    consumers see `None` end-markers once every producer finished."""
+
+    def __init__(self):
+        import threading
+
+        self._q: queue.Queue = queue.Queue()
+        self._producers = 0
+        self._lock = threading.Lock()
+
+    def set_producer_num(self, n: int) -> None:
+        with self._lock:
+            self._producers = n
+
+    def decrement_producer(self) -> None:
+        with self._lock:
+            self._producers -= 1
+            done = self._producers <= 0
+        if done:
+            self._q.put(None)
+
+    def put(self, item) -> None:
+        self._q.put(item)
+
+    def get(self):
+        item = self._q.get()
+        if item is None:
+            self._q.put(None)  # keep releasing other consumers
+        return item

@@ -1,0 +1,77 @@
+"""Vertex ranges and per-vertex arrays.
+
+Re-design of `grape/utils/vertex_array.h:37-573`: `Vertex` (typed lid),
+`VertexRange` / `DualVertexRange` (contiguous / two-segment lid spans)
+and `VertexArray` (dense per-vertex storage indexed by Vertex).
+
+On TPU a VertexArray *is* a jnp array row of the fragment state — these
+host-side helpers exist for loaders, assemble/output code and tests;
+device code indexes arrays by lid directly (the zero-cost form of the
+reference's `Vertex` wrapper).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class VertexRange:
+    """[begin, end) of local ids (reference vertex_array.h VertexRange)."""
+
+    begin: int
+    end: int
+
+    def __len__(self) -> int:
+        return max(0, self.end - self.begin)
+
+    def __iter__(self):
+        return iter(range(self.begin, self.end))
+
+    def __contains__(self, lid: int) -> bool:
+        return self.begin <= lid < self.end
+
+    def to_numpy(self) -> np.ndarray:
+        return np.arange(self.begin, self.end)
+
+
+@dataclass(frozen=True)
+class DualVertexRange:
+    """Two disjoint spans — the reference's inner-head/outer-tail layout
+    (`vertex_array.h` DualVertexRange; used by MutableEdgecutFragment)."""
+
+    head: VertexRange
+    tail: VertexRange
+
+    def __len__(self) -> int:
+        return len(self.head) + len(self.tail)
+
+    def __iter__(self):
+        yield from self.head
+        yield from self.tail
+
+    def __contains__(self, lid: int) -> bool:
+        return lid in self.head or lid in self.tail
+
+
+class VertexArray:
+    """Dense per-vertex values over a VertexRange, offset by its begin
+    (reference `VertexArray<T>`); numpy-backed."""
+
+    def __init__(self, vertices: VertexRange, init=0, dtype=None):
+        self.range = vertices
+        self.data = np.full(len(vertices), init, dtype=dtype)
+
+    def __getitem__(self, v):
+        return self.data[np.asarray(v) - self.range.begin]
+
+    def __setitem__(self, v, value):
+        self.data[np.asarray(v) - self.range.begin] = value
+
+    def set_value(self, value):
+        self.data[:] = value
+
+    def swap(self, other: "VertexArray"):
+        self.data, other.data = other.data, self.data

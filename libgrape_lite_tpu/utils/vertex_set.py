@@ -1,0 +1,55 @@
+"""Frontier abstraction.
+
+Re-design of `DenseVertexSet` (`grape/utils/vertex_set.h:32-443`):
+Insert/Exist/Count/PartialEmpty/Swap over a bitset — the frontier type
+of BFS/SSSP.
+
+On the TPU compute path a frontier is simply a boolean mask array
+(`frontier = changed & inner_mask`), which XLA fuses into the masked
+relaxation; this class provides the host-side API and documents the
+mapping.  `as_mask()` hands the device form back.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from libgrape_lite_tpu.utils.bitset import Bitset
+from libgrape_lite_tpu.utils.vertex_array import VertexRange
+
+
+class DenseVertexSet:
+    def __init__(self, vertices: VertexRange):
+        self.range = vertices
+        self._bits = Bitset(len(vertices))
+
+    def insert(self, v) -> None:
+        self._bits.set_bit(np.asarray(v) - self.range.begin)
+
+    def erase(self, v) -> None:
+        self._bits.reset_bit(np.asarray(v) - self.range.begin)
+
+    def exist(self, v):
+        return self._bits.get_bit(np.asarray(v) - self.range.begin)
+
+    def count(self) -> int:
+        return self._bits.count()
+
+    def empty(self) -> bool:
+        return self.count() == 0
+
+    def partial_empty(self, begin: int, end: int) -> bool:
+        lo, hi = begin - self.range.begin, end - self.range.begin
+        idx = np.arange(max(lo, 0), min(hi, len(self.range)))
+        return not bool(self._bits.get_bit(idx).any())
+
+    def clear(self) -> None:
+        self._bits.clear()
+
+    def swap(self, other: "DenseVertexSet") -> None:
+        self._bits, other._bits = other._bits, self._bits
+
+    def as_mask(self) -> np.ndarray:
+        """Boolean mask over the range — the device-side frontier form."""
+        idx = np.arange(len(self.range))
+        return np.asarray(self._bits.get_bit(idx))

@@ -1,0 +1,96 @@
+"""GNN sampler tests (analogue of `misc/sampler_test.sh`)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def frag():
+    from libgrape_lite_tpu.sampler import AppendOnlyEdgecutFragment
+
+    rng = np.random.default_rng(2)
+    n, e = 50, 400
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    w = rng.random(e).astype(np.float32)
+    return AppendOnlyEdgecutFragment(n, src, dst, w), (n, src, dst, w)
+
+
+def adj_of(n, src, dst):
+    adj = [[] for _ in range(n)]
+    for a, b in zip(src.tolist(), dst.tolist()):
+        adj[a].append(b)
+    return adj
+
+
+def test_random_sampling_valid(frag):
+    from libgrape_lite_tpu.sampler import GraphSampler
+
+    f, (n, src, dst, w) = frag
+    adj = adj_of(n, src, dst)
+    s = GraphSampler(f, "random")
+    qs = np.arange(20)
+    hops = s.sample(qs, fanouts=(4, 3), seed=1)
+    assert hops[0].shape == (20, 4) and hops[1].shape == (20, 12)
+    for i, q in enumerate(qs):
+        for x in hops[0][i]:
+            if adj[q]:
+                assert x in adj[q]
+            else:
+                assert x == -1
+
+
+def test_topk_sampling_deterministic(frag):
+    from libgrape_lite_tpu.sampler import GraphSampler
+
+    f, (n, src, dst, w) = frag
+    s = GraphSampler(f, "top_k")
+    qs = np.arange(10)
+    h1 = s.sample(qs, fanouts=(3,), seed=1)[0]
+    h2 = s.sample(qs, fanouts=(3,), seed=99)[0]
+    assert np.array_equal(h1, h2)  # top-k ignores the seed
+    # verify the picks are the max-weight neighbors
+    wmap = {}
+    for a, b, x in zip(src.tolist(), dst.tolist(), w.tolist()):
+        wmap.setdefault(a, []).append((x, b))
+    for i, q in enumerate(qs):
+        top = sorted(wmap.get(q, []), reverse=True)[:3]
+        expect = sorted(b for _, b in top)
+        got = sorted(x for x in h1[i].tolist() if x >= 0)
+        assert got == expect, (q, got, expect)
+
+
+def test_edge_weight_sampling_no_replacement(frag):
+    from libgrape_lite_tpu.sampler import GraphSampler
+
+    f, (n, src, dst, w) = frag
+    s = GraphSampler(f, "edge_weight")
+    hops = s.sample(np.arange(n), fanouts=(5,), seed=3)[0]
+    adj = adj_of(n, src, dst)
+    for q in range(n):
+        picks = [x for x in hops[q].tolist() if x >= 0]
+        assert len(picks) == min(5, len(adj[q]))
+
+
+def test_streaming_pipeline(tmp_path):
+    from libgrape_lite_tpu.sampler import AppendOnlyEdgecutFragment, GraphSampler
+    from libgrape_lite_tpu.sampler.stream import FileSink, FileSource, run_pipeline
+
+    src_file = tmp_path / "stream.txt"
+    src_file.write_text(
+        "e 0 1\ne 0 2\ne 1 2\nq 0\ne 2 3\nq 2\nq 7\n"
+    )
+    frag = AppendOnlyEdgecutFragment(4, np.zeros(0, int), np.zeros(0, int))
+    sampler = GraphSampler(frag, "random")
+    sink = FileSink(str(tmp_path / "out.txt"))
+    emitted = run_pipeline(
+        frag, sampler, FileSource(str(src_file)), sink, fanouts=(2,)
+    )
+    sink.close()
+    assert emitted == 3
+    lines = (tmp_path / "out.txt").read_text().strip().splitlines()
+    assert lines[0].startswith("0:")
+    samples0 = set(lines[0].split(":")[1].split())
+    assert samples0 <= {"1", "2"}
+    # vertex 7 unknown at query time: grows the id space, no neighbors
+    assert lines[2].strip() == "7:"

@@ -1,0 +1,111 @@
+"""Substrate unit tests: archives/varint, bitsets, vertex sets,
+id parser, thread pool, edge-balanced tiles."""
+
+import numpy as np
+
+
+def test_varint_roundtrip():
+    from libgrape_lite_tpu.utils.archive import varint_decode, varint_encode
+
+    rng = np.random.default_rng(0)
+    vals = np.concatenate(
+        [
+            rng.integers(0, 128, 100),
+            rng.integers(0, 1 << 20, 100),
+            rng.integers(0, 1 << 62, 100),
+            [0, 1, 127, 128, (1 << 64) - 1],
+        ]
+    ).astype(np.uint64)
+    assert np.array_equal(varint_decode(varint_encode(vals)), vals)
+    assert varint_encode(np.zeros(0, np.uint64)) == b""
+
+
+def test_delta_varint_compresses_sorted_streams():
+    from libgrape_lite_tpu.utils.archive import (
+        delta_varint_decode,
+        delta_varint_encode,
+        varint_encode,
+    )
+
+    gids = np.sort(np.random.default_rng(1).integers(0, 1 << 22, 5000)).astype(
+        np.uint64
+    )
+    enc = delta_varint_encode(gids)
+    assert np.array_equal(delta_varint_decode(enc), gids)
+    # dense sorted gid streams (deltas ~ range/n) compress well
+    assert len(enc) < 0.6 * len(varint_encode(gids))
+
+
+def test_archive_roundtrip():
+    from libgrape_lite_tpu.utils.archive import InArchive, OutArchive
+
+    ia = InArchive()
+    ia.add_scalar(42)
+    a = np.arange(10, dtype=np.int32)
+    b = np.linspace(0, 1, 7)
+    ia.add_array(a)
+    ia.add_array(b)
+    oa = OutArchive(ia.get_buffer())
+    assert oa.get_scalar() == 42
+    assert np.array_equal(oa.get_array(np.int32), a)
+    assert np.allclose(oa.get_array(np.float64), b)
+    assert oa.empty()
+
+
+def test_bitset_and_vertex_set():
+    from libgrape_lite_tpu.utils.bitset import Bitset
+    from libgrape_lite_tpu.utils.vertex_array import VertexRange
+    from libgrape_lite_tpu.utils.vertex_set import DenseVertexSet
+
+    bs = Bitset(200)
+    bs.set_bit(np.array([0, 63, 64, 199]))
+    assert bs.count() == 4
+    assert bs.get_bit(np.array([0, 1, 63, 64, 199])).tolist() == [
+        True, False, True, True, True,
+    ]
+    bs.reset_bit(np.array([63]))
+    assert bs.count() == 3
+
+    vs = DenseVertexSet(VertexRange(100, 300))
+    vs.insert(np.array([100, 150, 299]))
+    assert vs.count() == 3
+    assert vs.exist(np.array([150]))[0]
+    assert not vs.partial_empty(100, 160)
+    assert vs.partial_empty(160, 299)
+    mask = vs.as_mask()
+    assert mask.sum() == 3 and mask[0] and mask[50] and mask[199]
+
+
+def test_id_parser_bit_layout():
+    from libgrape_lite_tpu.utils.id_parser import IdParser
+
+    p = IdParser(fnum=8, max_lid_capacity=1 << 20)
+    fids = np.array([0, 3, 7])
+    lids = np.array([0, 12345, (1 << 20) - 1])
+    gids = p.generate(fids, lids)
+    assert np.array_equal(p.get_fid(gids), fids)
+    assert np.array_equal(p.get_lid(gids), lids)
+
+
+def test_thread_pool():
+    from libgrape_lite_tpu.utils.thread_pool import ThreadPool
+
+    tp = ThreadPool(4)
+    futs = [tp.enqueue(lambda x: x * x, i) for i in range(10)]
+    assert [f.result() for f in futs] == [i * i for i in range(10)]
+    assert tp.for_each(len, ["a", "bb", ""]) == [1, 2, 0]
+    tp.shutdown()
+
+
+def test_edge_balanced_tiles():
+    from libgrape_lite_tpu.parallel.engine import edge_balanced_tiles
+
+    # degrees 5, 0, 3, 8, 1 -> indptr
+    indptr = np.array([0, 5, 5, 8, 16, 17])
+    lo, hi = edge_balanced_tiles(indptr, tile_edges=4)
+    assert len(lo) == 5  # ceil(17/4)
+    # every edge index must fall inside its tile's row span
+    for t, (a, b) in enumerate(zip(lo, hi)):
+        e0, e1 = t * 4, min((t + 1) * 4, 17)
+        rows = np.searchsorted(indptr, np.arange(e0, e1), side="right") - 1
+        assert rows.min() >= a and rows.max() < b
