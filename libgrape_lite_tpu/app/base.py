@@ -61,6 +61,21 @@ class StepContext(Communicator):
         return lax.axis_index(FRAG_AXIS)
 
 
+def resolve_source(frag, source, app_name: str) -> int:
+    """oid -> pid for a query source, logging when absent (shared by
+    SSSP/BFS/BC; the reference's GetInnerVertex miss is silent, a
+    warning is strictly more debuggable)."""
+    pid = int(frag.oid_to_pid(np.array([source]))[0])
+    if pid < 0:
+        from libgrape_lite_tpu.utils import logging as glog
+
+        glog.log_info(
+            f"{app_name}: source {source!r} is not in the vertex map; "
+            "all vertices will be unreachable"
+        )
+    return pid
+
+
 class ContextBase:
     """Per-query mutable state descriptor (reference `context_base.h`).
     In the TPU build context state *is* the state pytree; this class only
@@ -99,6 +114,43 @@ class AppBase:
 
     def finalize(self, frag, state: Dict):
         raise NotImplementedError
+
+    # ---- MutationContext (reference grape/app/mutation_context.h) ----
+    #
+    # Apps that mutate the graph mid-query define `collect_mutations`;
+    # the stepwise worker calls it between supersteps
+    # (reference worker.h:211-222 applies staged mutations through
+    # BasicFragmentMutator between rounds) and rebuilds the fragment.
+    # State migrates by oid via `migrate_state` (default: aligned copy;
+    # new vertices take init_state defaults).
+
+    def migrate_state(self, old_frag, new_frag, old_state, new_state):
+        """Copy per-vertex state rows across a rebuild, matching by oid."""
+        old_oids = np.concatenate(
+            [old_frag.inner_oids(f) for f in range(old_frag.fnum)]
+        )
+        old_pids = old_frag.oid_to_pid(old_oids)
+        new_pids = new_frag.oid_to_pid(old_oids)
+        keep = new_pids >= 0
+        of, ol = old_pids[keep] // old_frag.vp, old_pids[keep] % old_frag.vp
+        nf, nl = new_pids[keep] // new_frag.vp, new_pids[keep] % new_frag.vp
+        out = dict(new_state)
+        for k, v in new_state.items():
+            if k in self.replicated_keys:
+                out[k] = old_state.get(k, v)
+                continue
+            ov = old_state.get(k)
+            if (
+                ov is not None
+                and np.ndim(ov) >= 2
+                and ov.shape[:2] == (old_frag.fnum, old_frag.vp)
+                and np.ndim(v) >= 2
+                and v.shape[:2] == (new_frag.fnum, new_frag.vp)
+            ):
+                nv = np.array(v)
+                nv[nf, nl] = ov[of, ol]
+                out[k] = nv
+        return out
 
     def trace_key(self):
         """Hashable fingerprint of every hyperparameter that gets baked

@@ -18,9 +18,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--vfile", default="")
     p.add_argument("--out_prefix", default="")
     p.add_argument("--directed", action="store_true")
-    p.add_argument("--sssp_source", type=int, default=0)
-    p.add_argument("--bfs_source", type=int, default=0)
-    p.add_argument("--bc_source", type=int, default=0)
+    # source ids parse as text so --string_id graphs can name their
+    # real ids; numeric strings coerce back to int in the runner
+    p.add_argument("--sssp_source", default="0")
+    p.add_argument("--bfs_source", default="0")
+    p.add_argument("--bc_source", default="0")
     p.add_argument("--kcore_k", type=int, default=0)
     p.add_argument("--kclique_k", type=int, default=3)
     p.add_argument("--pr_d", type=float, default=0.85)
@@ -39,6 +41,8 @@ def make_parser() -> argparse.ArgumentParser:
                    help="vertex-cut (2-D) storage; fnum must be k^2")
     p.add_argument("--delta_efile", default="")
     p.add_argument("--delta_vfile", default="")
+    p.add_argument("--string_id", action="store_true",
+                   help="treat vertex ids as strings (load_tests.cc:45)")
     p.add_argument("--rebalance", action="store_true")
     p.add_argument("--rebalance_vertex_factor", type=int, default=0)
     p.add_argument("--memory_stats", action="store_true")

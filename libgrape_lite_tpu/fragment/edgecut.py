@@ -178,6 +178,14 @@ class ShardedEdgecutFragment:
     def inner_vertices_num(self, fid: int) -> int:
         return int(np.asarray(self.dev.ivnum)[fid])
 
+    def is_string_keyed(self) -> bool:
+        """True when vertex oids are strings (--string_id graphs)."""
+        for f in range(self.fnum):
+            o = self.vertex_map.inner_oids(f)
+            if len(o):
+                return np.asarray(o).dtype.kind in "OUS"
+        return False
+
     def host_inner_mask(self) -> np.ndarray:
         """[fnum, vp] bool: True for real (non-padding) vertex rows —
         the single source of truth for padding semantics on the host
@@ -193,6 +201,12 @@ class ShardedEdgecutFragment:
     def oid_to_pid(self, oids: np.ndarray) -> np.ndarray:
         """oid -> padded global id (== reference gid bit layout)."""
         gids = self.vertex_map.get_gid(oids)
+        if (gids < 0).all() and np.asarray(oids).dtype.kind not in "OUS":
+            # string-keyed graph queried with a numeric id (e.g.
+            # --sssp_source 6 against --string_id): retry as text
+            as_str = np.array([str(o) for o in np.asarray(oids).tolist()],
+                              dtype=object)
+            gids = self.vertex_map.get_gid(as_str)
         fid = self.vertex_map.id_parser.get_fid(gids)
         lid = self.vertex_map.id_parser.get_lid(gids)
         pid = fid * self.vp + lid
@@ -332,7 +346,12 @@ class ShardedEdgecutFragment:
         oids = np.full((fnum, vp), -1, dtype=np.int64)
         for f in range(fnum):
             o = vertex_map.inner_oids(f)
-            oids[f, : len(o)] = o
+            if len(o) and np.asarray(o).dtype.kind in "OUS":
+                # string oids can't live on device: use the pid as a
+                # stable numeric surrogate (CDLP labels etc.)
+                oids[f, : len(o)] = f * vp + np.arange(len(o))
+            else:
+                oids[f, : len(o)] = o
 
         def stack_csr(csrs: list[CSR]) -> DeviceCSR:
             return DeviceCSR(

@@ -40,6 +40,7 @@ class LoadGraphSpec:
     idxer_type: str = "hashmap"  # sorted_array | hashmap | pthash | local
     rebalance: bool = False
     rebalance_vertex_factor: int = 0
+    string_id: bool = False  # reference --string_id (load_tests.cc:45)
     serialize: bool = False
     deserialize: bool = False
     serialization_prefix: str = ""
@@ -60,6 +61,7 @@ def _cache_dir(efile: str, vfile: str, spec: LoadGraphSpec, fnum: int) -> str:
             "partitioner": spec.partitioner_type,
             "idxer": spec.idxer_type,
             "rebalance": spec.rebalance,
+            "string_id": spec.string_id,
             "rebalance_vertex_factor": spec.rebalance_vertex_factor,
             "type": "ShardedEdgecutFragment",
         },
@@ -85,11 +87,13 @@ def LoadGraph(
     if spec.deserialize and cache and os.path.exists(os.path.join(cache, "sig")):
         return _deserialize_fragment(cache, comm_spec, spec)
 
-    src, dst, w = read_edge_file(efile, weighted=spec.weighted)
+    src, dst, w = read_edge_file(
+        efile, weighted=spec.weighted, string_id=spec.string_id
+    )
     if not spec.weighted:
         w = None
     if vfile:
-        oids = read_vertex_file(vfile)
+        oids = read_vertex_file(vfile, string_id=spec.string_id)
     else:
         # efile-only loading (reference basic_efile_fragment_loader.h):
         # vertex universe = endpoints, in first-appearance order
@@ -159,7 +163,7 @@ def _deserialize_fragment(
     from libgrape_lite_tpu.graph.csr import CSR
     from libgrape_lite_tpu.utils.id_parser import IdParser
 
-    z = np.load(os.path.join(cache, "frag.npz"))
+    z = np.load(os.path.join(cache, "frag.npz"), allow_pickle=True)
     fnum = int(z["fnum"])
     if fnum != comm_spec.fnum:
         raise ValueError(

@@ -67,25 +67,60 @@ def _parse_columns(data: bytes, int_cols: int, want_cols: int):
     return cols
 
 
-def read_vertex_file(path: str) -> np.ndarray:
-    """Read a .v file; returns int64 oids (first column)."""
+def _parse_string_table(data: bytes, id_cols: int, weighted: bool):
+    """String-oid parse (reference `--string_id`, load_tests.cc:45):
+    id columns stay str objects; a trailing weight parses as float."""
+    if _pd is None:
+        rows = [
+            line.split()
+            for line in data.decode().splitlines()
+            if line.strip() and not line.lstrip().startswith("#")
+        ]
+        cols = list(zip(*rows)) if rows else [[]] * (id_cols + weighted)
+        out = [np.asarray(cols[i], dtype=object) for i in range(id_cols)]
+        if weighted and len(cols) > id_cols:
+            out.append(np.asarray(cols[id_cols], dtype=np.float64))
+        return out
+    df = _pd.read_csv(
+        _io.BytesIO(data), sep=r"\s+", header=None, comment="#",
+        engine="c", dtype=str,
+    )
+    out = [df.iloc[:, i].to_numpy(dtype=object) for i in range(id_cols)]
+    if weighted and df.shape[1] > id_cols:
+        out.append(df.iloc[:, id_cols].to_numpy().astype(np.float64))
+    return out
+
+
+def read_vertex_file(path: str, string_id: bool = False) -> np.ndarray:
+    """Read a .v file; returns oids (int64, or str objects with
+    string_id)."""
     from libgrape_lite_tpu.io.native import parse_file_native
 
-    nat = parse_file_native(path, 1, False)
-    if nat is not None:
-        return nat[0]
+    if not string_id:
+        nat = parse_file_native(path, 1, False)
+        if nat is not None:
+            return nat[0]
     with open(path, "rb") as f:
         data = f.read()
+    if string_id:
+        return _parse_string_table(data, 1, False)[0]
     return _parse_columns(data, 1, 1)[0]
 
 
-def read_edge_file(path: str, weighted: bool):
+def read_edge_file(path: str, weighted: bool, string_id: bool = False):
     """Read a .e file; returns (src_oid, dst_oid, weight|None).
 
     Fast path: the native mmap+multithread parser (native/loader.cc,
     the analogue of the reference's C++ partial-read loaders); fallback:
-    pandas/numpy columnar parse."""
+    pandas/numpy columnar parse.  string_id keeps endpoint columns as
+    str objects (reference --string_id)."""
     from libgrape_lite_tpu.io.native import parse_file_native
+
+    if string_id:
+        with open(path, "rb") as f:
+            data = f.read()
+        cols = _parse_string_table(data, 2, weighted)
+        return cols[0], cols[1], cols[2] if len(cols) > 2 else None
 
     nat = parse_file_native(path, 2, weighted)
     if nat is not None:

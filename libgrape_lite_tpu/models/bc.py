@@ -40,7 +40,9 @@ class BC(ParallelAppBase):
         fnum, vp = frag.fnum, frag.vp
         depth = np.full((fnum, vp), _SENT, dtype=np.int32)
         pn = np.zeros((fnum, vp), dtype=np.float64)
-        pid = frag.oid_to_pid(np.array([source]))[0]
+        from libgrape_lite_tpu.app.base import resolve_source
+
+        pid = resolve_source(frag, source, "BC")
         if pid >= 0:
             depth[pid // vp, pid % vp] = 0
             pn[pid // vp, pid % vp] = 1.0

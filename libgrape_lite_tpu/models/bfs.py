@@ -28,7 +28,9 @@ class BFS(ParallelAppBase):
 
     def init_state(self, frag, source=0):
         depth = np.full((frag.fnum, frag.vp), _SENTINEL, dtype=np.int32)
-        pid = frag.oid_to_pid(np.array([source]))[0]
+        from libgrape_lite_tpu.app.base import resolve_source
+
+        pid = resolve_source(frag, source, "BFS")
         if pid >= 0:
             depth[pid // frag.vp, pid % frag.vp] = 0
         return {"depth": depth}

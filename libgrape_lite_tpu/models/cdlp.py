@@ -97,4 +97,17 @@ class CDLP(ParallelAppBase):
         return dict(labels=labels, step=step), active
 
     def finalize(self, frag, state):
-        return np.asarray(state["labels"])
+        labels = np.asarray(state["labels"])
+        if frag.is_string_keyed():
+            # device labels are pid surrogates (edgecut oids array);
+            # map back to the original string ids for output
+            flat = labels.reshape(-1)
+            uniq = np.unique(flat[flat >= 0])
+            lut = {
+                int(p): o
+                for p, o in zip(uniq, np.asarray(frag.pid_to_oid(uniq)).tolist())
+            }
+            return np.vectorize(
+                lambda x: lut.get(int(x), -1), otypes=[object]
+            )(labels)
+        return labels

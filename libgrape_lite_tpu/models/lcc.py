@@ -38,6 +38,7 @@ import numpy as np
 from jax import lax
 
 from libgrape_lite_tpu.app.base import ParallelAppBase, StepContext
+from libgrape_lite_tpu.ops.pallas_kernels import row_and_popcount
 from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
 from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
 
@@ -146,9 +147,7 @@ class LCC(ParallelAppBase):
                 sel = jnp.logical_and(jnp.logical_and(kept, fresh), nfid == cur_fid)
                 rows_v = bplus[jnp.minimum(srcs, vp - 1)]
                 rows_u = brot[nlid]
-                cnt = lax.population_count(rows_v & rows_u).sum(
-                    axis=1, dtype=jnp.int32
-                )
+                cnt = row_and_popcount(rows_v, rows_u)
                 cnt = jnp.where(sel, cnt, 0)
                 t = t.at[jnp.where(sel, srcs, vp - 1)].add(
                     jnp.where(sel, cnt, 0)
@@ -168,9 +167,7 @@ class LCC(ParallelAppBase):
                 sel = jnp.logical_and(jnp.logical_and(kept, fresh), nfid == cur_fid)
                 rows_w = bminus[jnp.minimum(srcs, vp - 1)]
                 rows_v = brot[nlid]
-                cnt = lax.population_count(rows_w & rows_v).sum(
-                    axis=1, dtype=jnp.int32
-                )
+                cnt = row_and_popcount(rows_w, rows_v)
                 t = t.at[jnp.where(sel, srcs, vp - 1)].add(
                     jnp.where(sel, cnt, 0)
                 )

@@ -21,6 +21,7 @@ import numpy as np
 from jax import lax
 
 from libgrape_lite_tpu.app.base import ParallelAppBase, StepContext
+from libgrape_lite_tpu.ops.pallas_kernels import row_and_popcount
 from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
 from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
 
@@ -94,9 +95,7 @@ class LCCDirected(ParallelAppBase):
                 sel = jnp.logical_and(jnp.logical_and(kp, fresh), nf == cur_fid)
                 rows_nb = nb_bm[jnp.minimum(s, vp - 1)]
                 rows_out = out_rot[nl]
-                cnt = lax.population_count(rows_nb & rows_out).sum(
-                    axis=1, dtype=jnp.int32
-                )
+                cnt = row_and_popcount(rows_nb, rows_out)
                 return t.at[jnp.where(sel, s, vp - 1)].add(
                     jnp.where(sel, cnt, jnp.int32(0))
                 )

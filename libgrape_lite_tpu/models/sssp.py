@@ -32,7 +32,9 @@ class SSSP(ParallelAppBase):
     def init_state(self, frag, source=0):
         dtype = frag.host_ie[0].edge_w.dtype if frag.weighted else np.float32
         dist = np.full((frag.fnum, frag.vp), np.inf, dtype=dtype)
-        pid = frag.oid_to_pid(np.array([source]))[0]
+        from libgrape_lite_tpu.app.base import resolve_source
+
+        pid = resolve_source(frag, source, "SSSP")
         if pid >= 0:
             dist[pid // frag.vp, pid % frag.vp] = 0.0
         return {"dist": dist}

@@ -54,9 +54,10 @@ class WCC(ParallelAppBase):
     def finalize(self, frag, state):
         comp = np.asarray(state["comp"]).astype(np.int64)
         # canonicalise: component id -> oid of representative pid
+        # (oids may be str objects for --string_id graphs)
         flat = comp.reshape(-1)
         reps = np.unique(flat[flat != np.iinfo(np.int32).max])
         rep_oids = frag.pid_to_oid(reps)
-        lut = {int(r): int(o) for r, o in zip(reps, rep_oids)}
-        out = np.vectorize(lambda c: lut.get(int(c), -1))(comp)
+        lut = {int(r): o for r, o in zip(reps, np.asarray(rep_oids).tolist())}
+        out = np.vectorize(lambda c: lut.get(int(c), -1), otypes=[object])(comp)
         return out

@@ -31,9 +31,9 @@ class QueryArgs:
     vfile: str = ""
     out_prefix: str = ""
     directed: bool = False
-    sssp_source: int = 0
-    bfs_source: int = 0
-    bc_source: int = 0
+    sssp_source: int | str = 0
+    bfs_source: int | str = 0
+    bc_source: int | str = 0
     kcore_k: int = 0
     kclique_k: int = 3
     pr_d: float = 0.85
@@ -45,6 +45,7 @@ class QueryArgs:
     idxer_type: str = "hashmap"
     rebalance: bool = False
     rebalance_vertex_factor: int = 0
+    string_id: bool = False
     memory_stats: bool = False
     profile: bool = False
     serialize: bool = False
@@ -56,13 +57,22 @@ class QueryArgs:
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
+def _coerce_source(v, string_id: bool):
+    if string_id or isinstance(v, int):
+        return v
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return v
+
+
 def build_query_kwargs(app_name: str, args: QueryArgs) -> dict:
     if app_name.startswith("sssp"):
-        return {"source": args.sssp_source}
+        return {"source": _coerce_source(args.sssp_source, args.string_id)}
     if app_name.startswith("bfs"):
-        return {"source": args.bfs_source}
+        return {"source": _coerce_source(args.bfs_source, args.string_id)}
     if app_name == "bc":
-        return {"source": args.bc_source}
+        return {"source": _coerce_source(args.bc_source, args.string_id)}
     if app_name == "kcore":
         return {"k": args.kcore_k}
     if app_name == "kclique":
@@ -97,6 +107,7 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
         idxer_type=args.idxer_type,
         rebalance=args.rebalance,
         rebalance_vertex_factor=args.rebalance_vertex_factor,
+        string_id=args.string_id,
         serialize=args.serialize,
         deserialize=args.deserialize,
         serialization_prefix=args.serialization_prefix,

@@ -19,9 +19,14 @@ import numpy as np
 class AppendOnlyEdgecutFragment:
     def __init__(self, n: int, src: np.ndarray, dst: np.ndarray,
                  w: np.ndarray | None = None, rebuild_threshold: float = 0.25):
-        self.n = n
         self._src = np.asarray(src, dtype=np.int64)
         self._dst = np.asarray(dst, dtype=np.int64)
+        # the id space grows with the data, exactly like flush()
+        self.n = max(
+            n,
+            int(self._src.max(initial=n - 1)) + 1,
+            int(self._dst.max(initial=n - 1)) + 1,
+        )
         self._w = None if w is None else np.asarray(w, dtype=np.float32)
         self._pending: list[tuple[int, int, float]] = []
         self.rebuild_threshold = rebuild_threshold

@@ -37,7 +37,20 @@ class HashPartitioner(PartitionerBase):
         self.fnum = fnum
 
     def get_partition_id(self, oids: np.ndarray) -> np.ndarray:
-        x = np.asarray(oids).astype(np.uint64, copy=True)
+        arr = np.asarray(oids)
+        if arr.dtype == object or arr.dtype.kind in "US":
+            # string oids (reference hashes the string bytes): stable
+            # crc32, hashed once per UNIQUE id, mapped back with
+            # searchsorted so endpoint arrays (O(E)) stay vectorised
+            import zlib
+
+            uniq, inv = np.unique(arr, return_inverse=True)
+            h = np.fromiter(
+                (zlib.crc32(str(o).encode()) % self.fnum for o in uniq.tolist()),
+                dtype=np.int64, count=len(uniq),
+            )
+            return h[inv]
+        x = arr.astype(np.uint64, copy=True)
         # 64-bit murmur3 finalizer
         x ^= x >> np.uint64(33)
         x *= np.uint64(0xFF51AFD7ED558CCD)

@@ -78,7 +78,15 @@ class VertexMap:
         gids = np.asarray(gids)
         fids = self.id_parser.get_fid(gids)
         lids = self.id_parser.get_lid(gids)
-        res = np.full(len(gids), -1, dtype=np.int64)
+        string_keyed = any(
+            ix.size() and np.asarray(ix.get_oid(np.array([0]))).dtype.kind in "OUS"
+            for ix in self.idxers
+        )
+        res = (
+            np.full(len(gids), -1, dtype=object)
+            if string_keyed
+            else np.full(len(gids), -1, dtype=np.int64)
+        )
         for f in range(self.fnum):
             m = fids == f
             if not m.any():

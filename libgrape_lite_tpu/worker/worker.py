@@ -134,6 +134,11 @@ class Worker:
             self.rounds = 0
             return self._result_state
 
+        if hasattr(app, "collect_mutations"):
+            # MutationContext apps need the host between supersteps;
+            # the fused while_loop cannot rebuild the fragment mid-loop
+            return self.query_stepwise(max_rounds, **query_args)
+
         state = self._place_state(app.init_state(frag, **query_args))
         runner = self._runner_for(mr, state)
         out_state, rounds = runner(frag.dev, state)
@@ -185,11 +190,14 @@ class Worker:
         )
 
     def query_stepwise(self, max_rounds: int | None = None, **query_args):
-        """PROFILING-mode query: drive rounds from the host, one jitted
-        superstep per round, logging per-round wall time and the
-        termination vote — the observable behavior of the reference's
-        coordinator logs (`worker.h:120-139`) and -DPROFILING timers.
-        Slower than `query` (host sync per round); results identical."""
+        """Host-driven query: one jitted superstep per round with
+        per-round wall time + termination-vote logs — the observable
+        behavior of the reference's coordinator logs (`worker.h:120-139`)
+        and -DPROFILING timers.  Also the execution mode for
+        MutationContext apps (`query` routes them here), since the graph
+        can be rebuilt between rounds.  Slower than the fused `query`
+        (host sync per round); results are identical for mutation-free
+        apps."""
         import time
 
         from libgrape_lite_tpu.utils import logging as glog
@@ -210,6 +218,34 @@ class Worker:
         state, active = jax.block_until_ready(peval_fn(frag.dev, state))
         glog.vlog(1, f"PEval: {time.perf_counter() - t0:.6f}s active={int(active)}")
         rounds = 0
+        has_mutations = hasattr(app, "collect_mutations")
+
+        def apply_mutations_if_any(state, frag, inc_fn, rounds):
+            host_state = {
+                k: np.asarray(v) for k, v in jax.device_get(state).items()
+            }
+            mutator = app.collect_mutations(frag, host_state, rounds)
+            if mutator is None:
+                return state, frag, inc_fn, False
+            old_frag = frag
+            frag = mutator.mutate(frag)
+            self.fragment = frag
+            fresh = app.init_state(frag, **query_args)
+            migrated = app.migrate_state(old_frag, frag, host_state, fresh)
+            state = self._place_state(migrated)
+            inc_fn = self._compile_single_step("inceval", state)
+            glog.vlog(1, f"applied mutations after round {rounds}")
+            return state, frag, inc_fn, True
+
+        if has_mutations:
+            # mutations staged during PEval apply even when the query
+            # would otherwise converge immediately (worker.h:211-222
+            # applies them every round boundary)
+            state, frag, inc_fn, changed = apply_mutations_if_any(
+                state, frag, inc_fn, 0
+            )
+            if changed:
+                active = 1
         while int(active) > 0 and rounds < mr:
             t0 = time.perf_counter()
             state, active = jax.block_until_ready(inc_fn(frag.dev, state))
@@ -219,6 +255,13 @@ class Worker:
                 f"IncEval round {rounds}: {time.perf_counter() - t0:.6f}s "
                 f"active={int(active)}",
             )
+            if has_mutations:
+                # MutationContext path (reference worker.h:211-222)
+                state, frag, inc_fn, changed = apply_mutations_if_any(
+                    state, frag, inc_fn, rounds
+                )
+                if changed:
+                    active = 1  # the new topology must be re-evaluated
         self.rounds = rounds
         self._result_state = state
         return state
@@ -255,7 +298,8 @@ def format_result_lines(oids, vals, fmt: str) -> str:
     lines = []
     if fmt == "int":
         for o, v in zip(oids.tolist(), np.asarray(vals).tolist()):
-            lines.append(f"{o} {int(v)}")
+            # string-keyed graphs carry str component/community ids
+            lines.append(f"{o} {v if isinstance(v, str) else int(v)}")
     elif fmt == "sssp_infinity":
         for o, v in zip(oids.tolist(), np.asarray(vals).tolist()):
             if not np.isfinite(v):

@@ -1,0 +1,43 @@
+"""String-oid loading (reference --string_id, tests/load_tests.cc):
+SSSP over string-keyed p2p-31 must equal the int-keyed golden."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import dataset_path
+from tests.verifiers import exact_verify, load_golden
+
+
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_string_id_sssp(tmp_path, fnum):
+    from libgrape_lite_tpu.fragment.loader import LoadGraph, LoadGraphSpec
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.worker.worker import Worker, format_result_lines
+
+    # string-ify the dataset ids ("v<k>")
+    with open(dataset_path("p2p-31.v")) as f:
+        vlines = [l.split() for l in f if l.strip()]
+    with open(dataset_path("p2p-31.e")) as f:
+        elines = [l.split() for l in f if l.strip()]
+    vf = tmp_path / "s.v"
+    ef = tmp_path / "s.e"
+    vf.write_text("\n".join(f"v{p[0]} {p[1]}" for p in vlines) + "\n")
+    ef.write_text(
+        "\n".join(f"v{p[0]} v{p[1]} {p[2]}" for p in elines) + "\n"
+    )
+
+    spec = LoadGraphSpec(
+        weighted=True, edata_dtype=np.float64, string_id=True
+    )
+    frag = LoadGraph(str(ef), str(vf), CommSpec(fnum=fnum), spec)
+    w = Worker(SSSP(), frag)
+    w.query(source="v6")
+    vals = w.result_values()
+    res = {}
+    for f in range(frag.fnum):
+        n = frag.inner_vertices_num(f)
+        for o, v in zip(frag.inner_oids(f).tolist(), vals[f, :n].tolist()):
+            # strip the v-prefix to compare against the int golden
+            res[int(o[1:])] = "infinity" if not np.isfinite(v) else f"{v:.15e}"
+    exact_verify(res, load_golden(dataset_path("p2p-31-SSSP")))
