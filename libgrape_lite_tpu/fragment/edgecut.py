@@ -180,11 +180,7 @@ class ShardedEdgecutFragment:
 
     def is_string_keyed(self) -> bool:
         """True when vertex oids are strings (--string_id graphs)."""
-        for f in range(self.fnum):
-            o = self.vertex_map.inner_oids(f)
-            if len(o):
-                return np.asarray(o).dtype.kind in "OUS"
-        return False
+        return self.vertex_map.is_string_keyed()
 
     def host_inner_mask(self) -> np.ndarray:
         """[fnum, vp] bool: True for real (non-padding) vertex rows —

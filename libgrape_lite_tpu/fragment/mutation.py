@@ -226,11 +226,13 @@ def LoadGraphAndMutate(
 
     spec = spec or LoadGraphSpec()
 
-    src, dst, w = read_edge_file(efile, weighted=spec.weighted)
+    src, dst, w = read_edge_file(
+        efile, weighted=spec.weighted, string_id=spec.string_id
+    )
     if not spec.weighted:
         w = None
     if vfile:
-        oids = read_vertex_file(vfile)
+        oids = read_vertex_file(vfile, string_id=spec.string_id)
     else:
         oids = np.unique(np.concatenate([src, dst]))
 

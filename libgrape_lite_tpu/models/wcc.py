@@ -59,5 +59,5 @@ class WCC(ParallelAppBase):
         reps = np.unique(flat[flat != np.iinfo(np.int32).max])
         rep_oids = frag.pid_to_oid(reps)
         lut = {int(r): o for r, o in zip(reps, np.asarray(rep_oids).tolist())}
-        out = np.vectorize(lambda c: lut.get(int(c), -1), otypes=[object])(comp)
-        return out
+        otype = object if frag.is_string_keyed() else np.int64
+        return np.vectorize(lambda c: lut.get(int(c), -1), otypes=[otype])(comp)

@@ -126,6 +126,12 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
     if is_vc and (args.delta_efile or args.delta_vfile):
         raise ValueError("--delta_efile/--delta_vfile are not supported "
                          "with vertex-cut storage")
+    if is_vc and args.string_id:
+        raise ValueError(
+            "--string_id is not supported with vertex-cut storage (the "
+            "reference's VC fragment is specialized to uint64 oids, "
+            "immutable_vertexcut_fragment.h)"
+        )
 
     with timer.phase("load graph"):
         if is_vc:

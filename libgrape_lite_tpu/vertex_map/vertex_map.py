@@ -29,6 +29,17 @@ class VertexMap:
         self.idxers = idxers
         self.id_parser = id_parser
         self.fnum = len(idxers)
+        self._string_keyed = None
+
+    def is_string_keyed(self) -> bool:
+        """True when oids are strings (--string_id graphs); cached."""
+        if self._string_keyed is None:
+            self._string_keyed = any(
+                ix.size()
+                and np.asarray(ix.get_oid(np.array([0]))).dtype.kind in "OUS"
+                for ix in self.idxers
+            )
+        return self._string_keyed
 
     @classmethod
     def build(
@@ -43,6 +54,13 @@ class VertexMap:
         lids within a fragment follow oid arrival order (vfile order),
         matching the reference's hashmap idxer."""
         fnum = partitioner.get_fnum()
+        oids_arr = np.asarray(oids)
+        if len(oids_arr) and len(np.unique(oids_arr)) != len(oids_arr):
+            raise ValueError(
+                "duplicate vertex oids in the vertex file — if the ids "
+                "are strings, load with string_id=True (--string_id); a "
+                "string file parsed as integers collapses to zeros"
+            )
         fids = partitioner.get_partition_id(oids)
         idxers = []
         max_ivnum = 0
@@ -78,13 +96,9 @@ class VertexMap:
         gids = np.asarray(gids)
         fids = self.id_parser.get_fid(gids)
         lids = self.id_parser.get_lid(gids)
-        string_keyed = any(
-            ix.size() and np.asarray(ix.get_oid(np.array([0]))).dtype.kind in "OUS"
-            for ix in self.idxers
-        )
         res = (
             np.full(len(gids), -1, dtype=object)
-            if string_keyed
+            if self.is_string_keyed()
             else np.full(len(gids), -1, dtype=np.int64)
         )
         for f in range(self.fnum):

@@ -51,7 +51,15 @@ def _unsqueeze_state(state, replicated):
 
 class Worker:
     """Binds an app to a sharded fragment and runs queries
-    (reference `Worker<APP_T, MESSAGE_MANAGER_T>`)."""
+    (reference `Worker<APP_T, MESSAGE_MANAGER_T>`).
+
+    Failure handling follows the reference's cooperative-abort scope
+    (`default_message_manager.h:156-166`, `ForceTerminate` +
+    `TerminateInfo`): an app votes a NEGATIVE active value to abort;
+    the psum carries it to every shard, the loop stops, and
+    `get_terminate_info()` reports the failure.  There is no
+    checkpoint-restart of in-flight queries — fail-fast, like the
+    reference."""
 
     def __init__(self, app: AppBase, fragment: ShardedEdgecutFragment):
         self.app = app
@@ -60,6 +68,17 @@ class Worker:
         self._runner_cache = {}
         self.rounds = 0
         self._result_state = None
+        self._terminate_code = 0
+
+    def get_terminate_info(self):
+        """(success, info) — reference `Worker::GetTerminateInfo`
+        (worker.h:150-152)."""
+        if self._terminate_code >= 0:
+            return True, ""
+        return False, (
+            f"query force-terminated with code {self._terminate_code} "
+            f"after {self.rounds} rounds"
+        )
 
     # ---- Init (reference worker.h:82-100) is construction above ----
 
@@ -88,7 +107,7 @@ class Worker:
             st, active, rounds = lax.while_loop(
                 cond, body, (st, jnp.int32(active), jnp.int32(0))
             )
-            return _unsqueeze_state(st, replicated), rounds
+            return _unsqueeze_state(st, replicated), rounds, active
 
         frag_spec = P(FRAG_AXIS)
 
@@ -101,7 +120,7 @@ class Worker:
                 stepper,
                 mesh=mesh,
                 in_specs=(frag_spec, specs),
-                out_specs=(specs, P()),
+                out_specs=(specs, P(), P()),
                 check_vma=False,
             )
             return jax.jit(sm)
@@ -141,9 +160,10 @@ class Worker:
 
         state = self._place_state(app.init_state(frag, **query_args))
         runner = self._runner_for(mr, state)
-        out_state, rounds = runner(frag.dev, state)
+        out_state, rounds, active = runner(frag.dev, state)
         out_state = jax.block_until_ready(out_state)
         self.rounds = int(rounds)
+        self._terminate_code = min(0, int(active))
         self._result_state = out_state
         return out_state
 
@@ -240,11 +260,12 @@ class Worker:
         if has_mutations:
             # mutations staged during PEval apply even when the query
             # would otherwise converge immediately (worker.h:211-222
-            # applies them every round boundary)
+            # applies them every round boundary); a ForceTerminate vote
+            # (negative active) still wins
             state, frag, inc_fn, changed = apply_mutations_if_any(
                 state, frag, inc_fn, 0
             )
-            if changed:
+            if changed and int(active) >= 0:
                 active = 1
         while int(active) > 0 and rounds < mr:
             t0 = time.perf_counter()
@@ -256,13 +277,21 @@ class Worker:
                 f"active={int(active)}",
             )
             if has_mutations:
-                # MutationContext path (reference worker.h:211-222)
+                # MutationContext path (reference worker.h:211-222);
+                # never overrides a ForceTerminate vote
                 state, frag, inc_fn, changed = apply_mutations_if_any(
                     state, frag, inc_fn, rounds
                 )
-                if changed:
+                if changed and int(active) >= 0:
                     active = 1  # the new topology must be re-evaluated
+                    if rounds >= mr:
+                        glog.log_info(
+                            "mutation applied on the final permitted round; "
+                            "the rebuilt topology was NOT re-evaluated — "
+                            "raise max_rounds"
+                        )
         self.rounds = rounds
+        self._terminate_code = min(0, int(active))
         self._result_state = state
         return state
 

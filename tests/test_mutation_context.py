@@ -28,12 +28,10 @@ def test_app_staged_mutation_mid_query():
             m.AddEdge(100, 9, 0.5)
             return m
 
-    # chain 0-1-2-...-9, weight 1 per hop
+    # chain 0-1-2-...-9, weight 1 per hop; built mutable directly
     src = np.arange(9)
     dst = np.arange(1, 10)
     w = np.ones(9)
-    frag = build_fragment(src, dst, w, 10, 2)
-    # build_fragment has no retain flag; rebuild it mutable
     from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
     from libgrape_lite_tpu.parallel.comm_spec import CommSpec
     from libgrape_lite_tpu.vertex_map.partitioner import MapPartitioner

@@ -73,3 +73,41 @@ def test_lcc_tiny_graph_sharded():
         [w.result_values()[f, : frag.inner_vertices_num(f)] for f in range(4)]
     )
     np.testing.assert_allclose(vals, [1.0, 1.0, 1 / 3, 0.0], atol=1e-12)
+
+
+def test_force_terminate():
+    """Cooperative abort (reference ForceTerminate + TerminateInfo):
+    a negative active vote stops the loop on every shard and surfaces
+    failure info."""
+    import jax.numpy as jnp
+
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    class AbortingSSSP(SSSP):
+        def inceval(self, ctx, frag, state):
+            state, active = super().inceval(ctx, frag, state)
+            # abort once more than 3 vertices have settled
+            settled = ctx.sum(
+                jnp.logical_and(
+                    jnp.isfinite(state["dist"]), frag.inner_mask
+                ).sum().astype(jnp.int32)
+            )
+            return state, jnp.where(settled > 3, jnp.int32(-7), active)
+
+    rng = np.random.default_rng(0)
+    src, dst = rng.integers(0, 32, 128), rng.integers(0, 32, 128)
+    w = rng.random(128)
+    frag = build_fragment(src, dst, w, 32, 2)
+    worker = Worker(AbortingSSSP(), frag)
+    worker.query(source=0)
+    ok, info = worker.get_terminate_info()
+    assert not ok
+    assert "code -7" in info
+
+    # a clean run reports success
+    from libgrape_lite_tpu.models import SSSP as CleanSSSP
+
+    w2 = Worker(CleanSSSP(), frag)
+    w2.query(source=0)
+    assert w2.get_terminate_info() == (True, "")
