@@ -29,14 +29,11 @@ import numpy as np
 from libgrape_lite_tpu.app.base import ParallelAppBase, StepContext
 from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
 
-_BIG = np.iinfo(np.int64).max
-
-
 class CDLP(ParallelAppBase):
     load_strategy = LoadStrategy.kOnlyOut
     message_strategy = MessageStrategy.kAlongOutgoingEdgeToOuterVertex
     result_format = "int"
-    replicated_keys = frozenset({"step"})
+    replicated_keys = frozenset({"step", "lut"})
 
     def __init__(self, max_round: int = 10, label_dtype=np.int64):
         self.max_round = max_round
@@ -45,11 +42,29 @@ class CDLP(ParallelAppBase):
     def init_state(self, frag, max_round: int | None = None):
         if max_round is not None:
             self.max_round = max_round
-        oids = np.asarray(frag.dev.oids).astype(self.label_dtype)
-        labels = np.where(oids >= 0, oids, _BIG)
-        return {"labels": labels, "step": np.int32(0)}
+        import jax
 
-    def _propagate(self, ctx, frag, labels):
+        eff_dt = np.dtype(self.label_dtype)
+        if eff_dt == np.int64 and not jax.config.jax_enable_x64:
+            # device arrays will be int32 anyway; build host arrays in
+            # the effective dtype so the BIG sentinel doesn't wrap
+            eff_dt = np.dtype(np.int32)
+        raw = np.asarray(frag.dev.oids)
+        if raw.max(initial=0) >= np.iinfo(eff_dt).max:
+            raise ValueError(
+                f"vertex ids exceed the {eff_dt} label range; enable "
+                "jax_enable_x64 (or pass label_dtype=np.int64 under x64) "
+                "for 64-bit ids"
+            )
+        oids = raw.astype(eff_dt)
+        big = np.iinfo(eff_dt).max
+        labels = np.where(oids >= 0, oids, big)
+        # static sorted label universe (labels only ever move between
+        # existing ids); +1 slot so searchsorted results stay in range
+        lut = np.sort(np.append(labels.reshape(-1), big))
+        return {"labels": labels, "step": np.int32(0), "lut": lut}
+
+    def _propagate(self, ctx, frag, labels, lut=None):
         oe = frag.oe
         vp = frag.vp
         dt = labels.dtype
@@ -59,9 +74,27 @@ class CDLP(ParallelAppBase):
         lab = jnp.where(oe.edge_mask, full[oe.edge_nbr], big)
         src = jnp.where(oe.edge_mask, oe.edge_src, jnp.int32(vp))
 
-        order = jnp.lexsort((lab, src))
-        ss = src[order]
-        ll = lab[order]
+        n_pad = vp * frag.fnum
+        rank_bits = max(1, int(np.ceil(np.log2(n_pad + 2))))
+        src_bits = max(1, int(np.ceil(np.log2(vp + 2))))
+        if rank_bits + src_bits <= 32:
+            # labels always belong to the initial id universe, so they
+            # rank into a static sorted LUT; packing (src, rank) into
+            # one uint32 key lets ONE sort replace the two-key lexsort,
+            # and (ss, ll) decode straight from the sorted keys — no
+            # permutation gather
+            rank = jnp.searchsorted(lut, lab).astype(jnp.uint32)
+            key = (src.astype(jnp.uint32) << rank_bits) | rank
+            key = jnp.sort(key)
+            ss = (key >> rank_bits).astype(jnp.int32)
+            ll = lut[
+                jnp.minimum(key & jnp.uint32((1 << rank_bits) - 1),
+                            jnp.uint32(n_pad)).astype(jnp.int32)
+            ]
+        else:  # huge-graph fallback: two-key stable sort
+            order = jnp.lexsort((lab, src))
+            ss = src[order]
+            ll = lab[order]
         valid = ss != jnp.int32(vp)
 
         first = jnp.ones_like(ss, dtype=bool).at[1:].set(
@@ -85,16 +118,16 @@ class CDLP(ParallelAppBase):
 
     def peval(self, ctx: StepContext, frag, state):
         # reference PEval: step=1, one propagation (cdlp.h PEval)
-        labels = self._propagate(ctx, frag, state["labels"])
-        state = dict(labels=labels, step=jnp.int32(1))
+        labels = self._propagate(ctx, frag, state["labels"], state["lut"])
+        state = dict(state, labels=labels, step=jnp.int32(1))
         active = jnp.int32(1 if self.max_round > 1 else 0)
         return state, active
 
     def inceval(self, ctx: StepContext, frag, state):
         step = state["step"] + 1
-        labels = self._propagate(ctx, frag, state["labels"])
+        labels = self._propagate(ctx, frag, state["labels"], state["lut"])
         active = jnp.where(step >= jnp.int32(self.max_round), jnp.int32(0), jnp.int32(1))
-        return dict(labels=labels, step=step), active
+        return dict(state, labels=labels, step=step), active
 
     def finalize(self, frag, state):
         labels = np.asarray(state["labels"])
