@@ -27,6 +27,7 @@ from libgrape_lite_tpu.models.pagerank_local import PageRankLocal
 from libgrape_lite_tpu.models.kclique import KClique
 from libgrape_lite_tpu.models.pagerank_vc import PageRankVC
 from libgrape_lite_tpu.models.lcc_directed import LCCDirected
+from libgrape_lite_tpu.models.wcc_opt import WCCOpt
 from libgrape_lite_tpu.models.auto_apps import (
     BFSAuto,
     PageRankAuto,
@@ -43,7 +44,7 @@ APP_REGISTRY = {
     "bfs_opt": BFS,
     "wcc": WCC,
     "wcc_auto": WCCAuto,
-    "wcc_opt": WCC,
+    "wcc_opt": WCCOpt,
     "pagerank": PageRank,
     "pagerank_auto": PageRankAuto,
     "pagerank_parallel": PageRank,

@@ -64,7 +64,7 @@ class CDLP(ParallelAppBase):
         lut = np.sort(np.append(labels.reshape(-1), big))
         return {"labels": labels, "step": np.int32(0), "lut": lut}
 
-    def _propagate(self, ctx, frag, labels, lut=None):
+    def _propagate(self, ctx, frag, labels, lut):
         oe = frag.oe
         vp = frag.vp
         dt = labels.dtype

@@ -42,11 +42,17 @@ class WCC(ParallelAppBase):
         cand = jnp.where(csr.edge_mask, full[csr.edge_nbr], big)
         return self.segment_reduce(cand, csr.edge_src, frag.vp, "min")
 
+    def _post_pull(self, ctx, frag, new):
+        """Hook between the neighbor pull and the change count —
+        WCCOpt inserts pointer jumping here."""
+        return new
+
     def inceval(self, ctx: StepContext, frag, state):
         comp = state["comp"]
         new = jnp.minimum(comp, self._pull(ctx, frag, comp, frag.ie))
         if frag.directed:
             new = jnp.minimum(new, self._pull(ctx, frag, new, frag.oe))
+        new = self._post_pull(ctx, frag, new)
         changed = jnp.logical_and(new < comp, frag.inner_mask)
         active = ctx.sum(changed.sum().astype(jnp.int32))
         return {"comp": new}, active

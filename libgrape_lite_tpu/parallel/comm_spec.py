@@ -19,6 +19,34 @@ kCoordinatorRank = 0  # reference grape/config.h:64
 
 
 class CommSpec:
+    @classmethod
+    def init_distributed(cls, coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None,
+                         fnum: int | None = None) -> "CommSpec":
+        """Multi-host (DCN) initialization — the analogue of the
+        reference's `InitMPIComm` (`sync_comm.h:41-45`): bring up the
+        jax.distributed runtime so `jax.devices()` spans every host's
+        chips, then build the frag mesh over the global device list.
+        Collectives ride ICI within a slice and DCN across slices,
+        chosen by XLA from the mesh — no NCCL/MPI plumbing.  (Single
+        host: falls through to the plain constructor.)"""
+        if num_processes and num_processes > 1:
+            from jax._src import xla_bridge as _xb
+
+            if _xb.backends_are_initialized():
+                raise RuntimeError(
+                    "CommSpec.init_distributed must run before any JAX "
+                    "backend use (jax.distributed.initialize cannot "
+                    "attach to an initialized runtime)"
+                )
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        return cls(fnum=fnum)
+
     def __init__(self, fnum: int | None = None, devices=None):
         if devices is None:
             devices = jax.devices()

@@ -51,3 +51,33 @@ def test_pagerank_auto_directed(graph_cache, fnum):
         PageRankAuto(), graph_cache(fnum, directed=True), delta=0.85, max_round=10
     )
     eps_verify(res, load_golden(dataset_path("p2p-31-PR-directed")))
+
+
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_wcc_opt(graph_cache, fnum):
+    from libgrape_lite_tpu.models import WCCOpt
+
+    res = run_worker(WCCOpt(), graph_cache(fnum))
+    wcc_verify(res, load_golden(dataset_path("p2p-31-WCC")))
+
+
+def test_wcc_opt_fewer_rounds_on_chain():
+    """Pointer jumping converges in O(log D) rounds on a chain."""
+    import numpy as np
+
+    from libgrape_lite_tpu.models import WCC, WCCOpt
+    from libgrape_lite_tpu.worker.worker import Worker
+    from tests.test_worker import build_fragment
+
+    n = 512  # path graph: diameter 511
+    src, dst = np.arange(n - 1), np.arange(1, n)
+    frag = build_fragment(src, dst, None, n, 2)
+    w_plain = Worker(WCC(), frag)
+    w_plain.query()
+    w_opt = Worker(WCCOpt(), frag)
+    w_opt.query()
+    assert w_opt.rounds < w_plain.rounds / 4, (w_opt.rounds, w_plain.rounds)
+    # identical components
+    a = w_plain.result_values()
+    b = w_opt.result_values()
+    assert np.array_equal(a[:, :], b[:, :])
