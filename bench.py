@@ -151,6 +151,31 @@ def main():
         )
     )
 
+    if os.environ.get("GRAPE_BENCH_FULL"):
+        # side metrics on stderr AFTER the primary line is out — a hang
+        # or failure here must not cost the already-made measurement
+        import sys
+
+        from libgrape_lite_tpu.models import BFS, CDLP, WCC
+
+        for nm, a, kw in (
+            ("wcc", WCC(), {}),
+            ("bfs", BFS(), {"source": 0}),
+            ("cdlp", CDLP(), {"max_round": 10}),
+        ):
+            try:
+                wk = Worker(a, frag)
+                wk.query(**kw)  # compile
+                t0 = time.perf_counter()
+                wk.query(**kw)
+                print(
+                    f"[bench-extra] {nm}: {time.perf_counter() - t0:.4f}s "
+                    f"rounds={wk.rounds}",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # side metrics are best-effort
+                print(f"[bench-extra] {nm}: failed ({e})", file=sys.stderr)
+
 
 if __name__ == "__main__":
     main()
