@@ -28,6 +28,7 @@ from libgrape_lite_tpu.models.kclique import KClique
 from libgrape_lite_tpu.models.pagerank_vc import PageRankVC
 from libgrape_lite_tpu.models.lcc_directed import LCCDirected
 from libgrape_lite_tpu.models.wcc_opt import WCCOpt
+from libgrape_lite_tpu.models.sssp_msg import SSSPMsg
 from libgrape_lite_tpu.models.auto_apps import (
     BFSAuto,
     PageRankAuto,
@@ -39,6 +40,7 @@ APP_REGISTRY = {
     "sssp": SSSP,
     "sssp_auto": SSSPAuto,
     "sssp_opt": SSSP,
+    "sssp_msg": SSSPMsg,
     "bfs": BFS,
     "bfs_auto": BFSAuto,
     "bfs_opt": BFS,

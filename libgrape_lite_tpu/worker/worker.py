@@ -150,7 +150,7 @@ class Worker:
             # host-engine apps (irregular recursion, e.g. kclique) skip
             # the traced superstep loop entirely
             self._result_state = app.host_compute(frag, **query_args)
-            self.rounds = 0
+            self.rounds = getattr(app, "rounds", 0)
             return self._result_state
 
         if hasattr(app, "collect_mutations"):
