@@ -45,7 +45,7 @@ class SSSPMsg(AppBase):
         self.final_capacity = self.initial_capacity
         self._round_cache = {}  # (frag id, capacity) -> compiled step
 
-    def host_compute(self, frag, source=0):
+    def host_compute(self, frag, source=0, max_rounds: int | None = None):
         comm_spec = frag.comm_spec
         fnum, vp = frag.fnum, frag.vp
         dtype = frag.host_oe[0].edge_w.dtype if frag.weighted else np.float32
@@ -59,8 +59,15 @@ class SSSPMsg(AppBase):
 
         def round_for(cap: int):
             # persistent across queries (the Worker._runner_cache
-            # pattern): keyed on fragment identity + capacity
-            key = (id(frag), cap)
+            # pattern): keyed on a weakref so a recycled id can never
+            # alias a different fragment; dead entries are purged
+            import weakref
+
+            self._round_cache = {
+                k: v for k, v in self._round_cache.items()
+                if k[0]() is not None
+            }
+            key = (weakref.ref(frag), cap)
             if key in self._round_cache:
                 return self._round_cache[key]
 
@@ -105,8 +112,9 @@ class SSSPMsg(AppBase):
         cap = self.initial_capacity
         self.rounds = 0
         self.retries = 0
+        limit = max_rounds if (max_rounds and max_rounds > 0) else None
         active = 1
-        while active > 0:
+        while active > 0 and (limit is None or self.rounds < limit):
             new_dist, new_changed, active_d, ovf = round_for(cap)(
                 frag.dev, dist, changed
             )

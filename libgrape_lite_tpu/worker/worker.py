@@ -148,8 +148,14 @@ class Worker:
 
         if getattr(app, "host_only", False):
             # host-engine apps (irregular recursion, e.g. kclique) skip
-            # the traced superstep loop entirely
-            self._result_state = app.host_compute(frag, **query_args)
+            # the traced superstep loop entirely; iterative ones honor
+            # the same round bound as everyone else
+            import inspect
+
+            kwargs = dict(query_args)
+            if "max_rounds" in inspect.signature(app.host_compute).parameters:
+                kwargs["max_rounds"] = mr
+            self._result_state = app.host_compute(frag, **kwargs)
             self.rounds = getattr(app, "rounds", 0)
             return self._result_state
 

@@ -47,6 +47,17 @@ for np in 1 2 4 8; do
   echo "wcc";           run $np wcc;                         verify wcc p2p-31-WCC
 done
 
+echo "== strategy variants (fnum=4) =="
+echo "sssp_msg";  run 4 sssp_msg --sssp_source=6;  verify exact p2p-31-SSSP
+echo "wcc_opt";   run 4 wcc_opt;                   verify wcc p2p-31-WCC
+echo "pagerank_push"; run 4 pagerank_push --pr_mr=10; verify eps p2p-31-PR
+
+echo "== extra apps smoke (fnum=2, no goldens ship) =="
+for app in bc kcore core_decomposition kclique lcc_directed; do
+  echo "$app"
+  run 2 $app --bc_source=6 --kcore_k=4 --kclique_k=3
+done
+
 echo "== directed (fnum=4) =="
 echo "sssp --directed"; run 4 sssp --sssp_source=6 --directed; verify exact p2p-31-SSSP-directed
 echo "bfs --directed";  run 4 bfs --bfs_source=6 --directed;   verify exact p2p-31-BFS-directed

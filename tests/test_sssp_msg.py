@@ -37,3 +37,14 @@ def test_sssp_msg_directed(graph_cache):
     frag = graph_cache(2, directed=True)
     res = run_worker(SSSPMsg(), frag, source=6)
     exact_verify(res, load_golden(dataset_path("p2p-31-SSSP-directed")))
+
+
+def test_sssp_msg_honors_max_rounds(graph_cache):
+    from libgrape_lite_tpu.models import SSSPMsg
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    app = SSSPMsg()
+    w = Worker(app, frag)
+    w.query(max_rounds=3, source=6)
+    assert w.rounds == 3  # bounded, not run to convergence (22 rounds)
