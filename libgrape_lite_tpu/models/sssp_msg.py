@@ -41,9 +41,12 @@ class SSSPMsg(AppBase):
     def __init__(self, initial_capacity: int = 1024):
         self.initial_capacity = max(1, initial_capacity)
         self.rounds = 0
+        import weakref
+
         self.retries = 0  # overflow-driven capacity regrows
         self.final_capacity = self.initial_capacity
-        self._round_cache = {}  # (frag id, capacity) -> compiled step
+        # fragment -> {capacity: compiled step}
+        self._round_cache = weakref.WeakKeyDictionary()
 
     def host_compute(self, frag, source=0, max_rounds: int | None = None):
         comm_spec = frag.comm_spec
@@ -59,17 +62,11 @@ class SSSPMsg(AppBase):
 
         def round_for(cap: int):
             # persistent across queries (the Worker._runner_cache
-            # pattern): keyed on a weakref so a recycled id can never
-            # alias a different fragment; dead entries are purged
-            import weakref
-
-            self._round_cache = {
-                k: v for k, v in self._round_cache.items()
-                if k[0]() is not None
-            }
-            key = (weakref.ref(frag), cap)
-            if key in self._round_cache:
-                return self._round_cache[key]
+            # pattern): WeakKeyDictionary keyed on the fragment, so a
+            # recycled id can never alias and dead entries self-purge
+            per_frag = self._round_cache.setdefault(frag, {})
+            if cap in per_frag:
+                return per_frag[cap]
 
             def step(frag_stacked, dist, changed):
                 lf = frag_stacked.local()
@@ -104,7 +101,7 @@ class SSSPMsg(AppBase):
                     check_vma=False,
                 )
             )
-            self._round_cache[key] = fn
+            per_frag[cap] = fn
             return fn
 
         dist = jnp.asarray(dist0)

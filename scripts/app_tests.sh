@@ -53,10 +53,12 @@ echo "wcc_opt";   run 4 wcc_opt;                   verify wcc p2p-31-WCC
 echo "pagerank_push"; run 4 pagerank_push --pr_mr=10; verify eps p2p-31-PR
 
 echo "== extra apps smoke (fnum=2, no goldens ship) =="
-for app in bc kcore core_decomposition kclique lcc_directed; do
+for app in bc kcore core_decomposition kclique; do
   echo "$app"
   run 2 $app --bc_source=6 --kcore_k=4 --kclique_k=3
 done
+echo "lcc_directed"
+run 2 lcc_directed --directed
 
 echo "== directed (fnum=4) =="
 echo "sssp --directed"; run 4 sssp --sssp_source=6 --directed; verify exact p2p-31-SSSP-directed

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""TPU profiling harness (round-2 perf loop, ROADMAP item 1).
+
+Runs each algorithm on an RMAT graph on the default backend, reports
+cold/warm timings and per-round costs, and (with --trace) captures an
+XLA profiler trace for tensorboard.
+
+  python scripts/tpu_profile.py [--scale 20] [--ef 16] [--fnum 1]
+      [--algorithms pagerank,sssp,bfs,wcc,cdlp] [--trace /tmp/trace]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", type=int, default=20)
+    p.add_argument("--ef", type=int, default=16)
+    p.add_argument("--fnum", type=int, default=None)
+    p.add_argument("--algorithms", default="pagerank,sssp,bfs,wcc,cdlp")
+    p.add_argument("--trace", default="")
+    p.add_argument("--platform", default="")
+    p.add_argument("--cpu_devices", type=int, default=0)
+    args = p.parse_args()
+
+    if args.cpu_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu_devices}"
+        ).strip()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    import bench as benchmod
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.models import APP_REGISTRY
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.id_parser import IdParser
+    from libgrape_lite_tpu.utils.memory import get_memory_stats
+    from libgrape_lite_tpu.vertex_map.partitioner import MapPartitioner
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    n, src, dst = benchmod.rmat_edges(args.scale, args.ef)
+    w = (np.abs(np.sin(src * 0.37 + dst * 0.71)) * 99 + 1).astype(np.float64)
+    comm = CommSpec(fnum=args.fnum)
+    oids = np.arange(n, dtype=np.int64)
+    part = MapPartitioner(comm.fnum, oids)
+    fids = part.get_partition_id(oids)
+    from libgrape_lite_tpu.vertex_map.idxer import HashMapIdxer
+
+    idxers = [HashMapIdxer(oids[fids == f]) for f in range(comm.fnum)]
+    vm = VertexMap(part, idxers, IdParser(comm.fnum, max(2, 2 * n // comm.fnum)))
+    t0 = time.perf_counter()
+    frag = ShardedEdgecutFragment.build(comm, vm, src, dst, w, directed=False)
+    print(f"build: {time.perf_counter() - t0:.2f}s  "
+          f"V=2^{args.scale} E={len(src)} fnum={comm.fnum} "
+          f"platform={jax.devices()[0].platform}")
+    print(f"memory: {get_memory_stats()}")
+
+    from libgrape_lite_tpu.runner import QueryArgs, build_query_kwargs
+
+    qargs = QueryArgs(sssp_source=0, bfs_source=0, bc_source=0,
+                      pr_d=0.85, pr_mr=10, cdlp_mr=10, kcore_k=4)
+
+    def kwargs_for(name):
+        return build_query_kwargs(name, qargs)
+
+    report = {}
+    for name in args.algorithms.split(","):
+        app = APP_REGISTRY[name]()
+        worker = Worker(app, frag)
+        kw = kwargs_for(name)
+        t0 = time.perf_counter()
+        worker.query(**kw)
+        cold = time.perf_counter() - t0
+        if args.trace:
+            with jax.profiler.trace(os.path.join(args.trace, name)):
+                worker.query(**kw)
+        t0 = time.perf_counter()
+        worker.query(**kw)
+        warm = time.perf_counter() - t0
+        per_round = warm / max(worker.rounds, 1)
+        report[name] = {
+            "cold_s": round(cold, 4),
+            "warm_s": round(warm, 4),
+            "rounds": worker.rounds,
+            "per_round_ms": round(per_round * 1e3, 3),
+        }
+        print(f"{name}: {report[name]}")
+
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()
