@@ -29,6 +29,7 @@ from libgrape_lite_tpu.models.pagerank_vc import PageRankVC
 from libgrape_lite_tpu.models.lcc_directed import LCCDirected
 from libgrape_lite_tpu.models.wcc_opt import WCCOpt
 from libgrape_lite_tpu.models.sssp_msg import SSSPMsg
+from libgrape_lite_tpu.models.lcc_beta import LCCBeta
 from libgrape_lite_tpu.models.auto_apps import (
     BFSAuto,
     PageRankAuto,
@@ -60,7 +61,7 @@ APP_REGISTRY = {
     "lcc": LCC,
     "lcc_auto": LCC,
     "lcc_opt": LCC,
-    "lcc_beta": LCC,
+    "lcc_beta": LCCBeta,
     "lcc_directed": LCCDirected,
     # pagerank already pulls over in-edges (pagerank_parallel.h
     # semantics), which is the directed-correct formulation

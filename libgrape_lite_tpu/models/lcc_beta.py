@@ -1,0 +1,202 @@
+"""LCCBeta — scalable LCC via sorted-adjacency merge intersection.
+
+Re-design of `examples/analytical_apps/lcc/lcc_beta.h` (the reference's
+alternative LCC) with the round-2 scaling goal (ROADMAP item 3): the
+packed-bitmap LCC (models/lcc.py) costs O(N/32) words per row — ideal
+for LDBC-scale graphs, wrong beyond ~2^21 vertices.  This variant
+intersects *sorted oriented neighbor lists* instead:
+
+  * the degree-oriented DAG's out-adjacency is materialised as a padded
+    ELL block `[vp, D] int32` (D = max oriented out-degree, bounded by
+    graph degeneracy — O(sqrt(2E)) worst case), rows sorted ascending;
+  * for every oriented edge (v, u): a batched `searchsorted` of N+(v)
+    into N+(u) finds the common members w — one pass yields all three
+    triangle credits (v and u by count, each w by scatter on the
+    matched values), so no reverse (N−) structure and no second pass;
+  * remote rows ride the same ring `ppermute` as the bitmap kernel;
+    credits accumulate in a pid-indexed vector folded by one `psum`.
+
+Working set is O(chunk · D) — independent of vertex count.  Exactness
+matches the golden within eps like models/lcc.py (same dedup
+orientation; simple-graph multiplicity assumption documented there).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from libgrape_lite_tpu.app.base import ParallelAppBase, StepContext
+from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
+from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
+
+
+class LCCBeta(ParallelAppBase):
+    load_strategy = LoadStrategy.kOnlyOut
+    message_strategy = MessageStrategy.kAlongOutgoingEdgeToOuterVertex
+    result_format = "float"
+
+    def init_state(self, frag, **_):
+        """Host prep: dedup degree-oriented out-adjacency as sorted,
+        padded ELL blocks (the analogue of lcc.h stage-1 neighbor
+        filtering, done once against the host CSRs)."""
+        fnum, vp = frag.fnum, frag.vp
+        n_pad = fnum * vp
+        sent = n_pad  # sorts last, never matches a valid query
+
+        # global degree (incl multiplicity) per pid
+        deg = np.zeros(n_pad, dtype=np.int64)
+        for f in range(fnum):
+            deg[f * vp : (f + 1) * vp] = np.diff(frag.host_oe[f].indptr)
+
+        rows_per_frag = []
+        cnts = np.zeros((fnum, vp), dtype=np.int32)
+        d_max = 1
+        ells = []
+        for f in range(fnum):
+            c = frag.host_oe[f]
+            e = c.num_edges
+            v = f * vp + c.edge_src[:e].astype(np.int64)
+            u = c.edge_nbr[:e].astype(np.int64)
+            pairs = np.unique(np.stack([v, u], 1), axis=0)
+            v, u = pairs[:, 0], pairs[:, 1]
+            keep = (deg[u] < deg[v]) | ((deg[u] == deg[v]) & (u < v))
+            keep &= u != v
+            v, u = v[keep], u[keep]
+            lid = (v - f * vp).astype(np.int64)
+            cnt = np.bincount(lid, minlength=vp).astype(np.int32)
+            cnts[f] = cnt
+            d_max = max(d_max, int(cnt.max(initial=1)))
+            rows_per_frag.append((lid, u, cnt))
+
+        for f in range(fnum):
+            lid, u, cnt = rows_per_frag[f]
+            ell = np.full((vp, d_max), sent, dtype=np.int64)
+            order = np.lexsort((u, lid))
+            lid_s, u_s = lid[order], u[order]
+            starts = np.zeros(vp, dtype=np.int64)
+            np.cumsum(cnt[:-1], out=starts[1:])
+            col = np.arange(len(lid_s)) - starts[lid_s]
+            ell[lid_s, col] = u_s  # ascending within each row (lexsort)
+            ells.append(ell)
+
+        return {
+            "ell": np.stack(ells).astype(np.int32),
+            "cnt": cnts,
+            "lcc": np.zeros((fnum, vp), dtype=np.float64),
+        }
+
+    def peval(self, ctx: StepContext, frag, state):
+        vp, fnum = frag.vp, frag.fnum
+        n_pad = vp * fnum
+        my_fid = lax.axis_index(FRAG_AXIS).astype(jnp.int32)
+
+        ell, cnt = state["ell"], state["cnt"]
+        d = ell.shape[-1]
+        oe = frag.oe
+
+        # oriented dedup edge mask (same rule as the ELL build)
+        from libgrape_lite_tpu.models.lcc import LCC
+
+        deg_local = frag.out_degree
+        deg_full = ctx.gather_state(deg_local)
+        row_pid = my_fid * vp + jnp.minimum(oe.edge_src, vp - 1)
+        d_row = deg_local[jnp.minimum(oe.edge_src, vp - 1)]
+        d_nbr = deg_full[oe.edge_nbr]
+        keep = jnp.logical_or(
+            d_nbr < d_row,
+            jnp.logical_and(d_nbr == d_row, oe.edge_nbr < row_pid),
+        )
+        keep = jnp.logical_and(LCC._dedup_mask(oe), keep)
+        keep = jnp.logical_and(keep, oe.edge_nbr != row_pid)
+
+        ep = oe.edge_src.shape[0]
+        # chunk size bounded so chunk*d stays ~4M int32 entries
+        c_e = max(128, min(4096, (1 << 22) // max(d, 1)))
+        c_e = min(c_e, ep)
+        n_chunks = max(1, -(-ep // c_e))
+        nbr_fid = (oe.edge_nbr // vp).astype(jnp.int32)
+        nbr_lid = (oe.edge_nbr % vp).astype(jnp.int32)
+
+        cred = jnp.zeros((n_pad + 1,), dtype=jnp.int32)
+
+        def pass_for(carry_cred, rot_ell, rot_cnt, cur_fid):
+            def body(i, cr):
+                start = jnp.minimum(i * c_e, ep - c_e)
+                pos0 = start + jnp.arange(c_e, dtype=jnp.int32)
+                fresh = pos0 >= i * c_e
+                srcs = lax.dynamic_slice(oe.edge_src, (start,), (c_e,))
+                nfid = lax.dynamic_slice(nbr_fid, (start,), (c_e,))
+                nlid = lax.dynamic_slice(nbr_lid, (start,), (c_e,))
+                kept = lax.dynamic_slice(keep, (start,), (c_e,))
+                sel = jnp.logical_and(jnp.logical_and(kept, fresh),
+                                      nfid == cur_fid)
+
+                sl = jnp.minimum(srcs, vp - 1)
+                q = ell[sl]                     # [C, D] queries (N+(v))
+                qv = jnp.arange(d)[None, :] < cnt[sl][:, None]
+                tgt = rot_ell[nlid]             # [C, D] sorted (N+(u))
+                tcnt = rot_cnt[nlid]
+
+                pos = jax.vmap(jnp.searchsorted)(tgt, q)  # [C, D]
+                pos_c = jnp.minimum(pos, d - 1)
+                hit = jnp.take_along_axis(tgt, pos_c, axis=1) == q
+                hit = jnp.logical_and(hit, pos < tcnt[:, None])
+                hit = jnp.logical_and(hit, qv)
+                hit = jnp.logical_and(hit, sel[:, None])
+
+                c1 = hit.sum(axis=1, dtype=jnp.int32)
+                v_pid = my_fid * vp + sl  # local row pid
+                u_pid = cur_fid * vp + nlid
+                cr = cr.at[jnp.where(sel, v_pid, n_pad)].add(
+                    jnp.where(sel, c1, 0)
+                )
+                cr = cr.at[jnp.where(sel, u_pid, n_pad)].add(
+                    jnp.where(sel, c1, 0)
+                )
+                # far-end credits: +1 per matched member value
+                w_idx = jnp.where(hit, q, jnp.int32(n_pad))
+                cr = cr.at[w_idx.reshape(-1)].add(
+                    hit.reshape(-1).astype(jnp.int32)
+                )
+                return cr
+
+            return lax.fori_loop(0, n_chunks, body, carry_cred)
+
+        if fnum == 1:
+            cred = pass_for(cred, ell, cnt, jnp.int32(0))
+        else:
+            perm = [(i, (i - 1) % fnum) for i in range(fnum)]
+
+            def ring_body(s, carry):
+                cr, r_ell, r_cnt = carry
+                cur_fid = (my_fid + s) % fnum
+                cr = pass_for(cr, r_ell, r_cnt, cur_fid)
+                r_ell = lax.ppermute(r_ell, FRAG_AXIS, perm)
+                r_cnt = lax.ppermute(r_cnt, FRAG_AXIS, perm)
+                return cr, r_ell, r_cnt
+
+            cred, _, _ = lax.fori_loop(
+                0, fnum, ring_body, (cred, ell, cnt)
+            )
+
+        total = ctx.sum(cred[:n_pad])
+        tri = lax.dynamic_slice(total, (my_fid * vp,), (vp,))
+
+        dt = state["lcc"].dtype
+        degf = deg_local.astype(dt)
+        denom = degf * (degf - 1)
+        lcc = jnp.where(
+            jnp.logical_and(frag.inner_mask, deg_local >= 2),
+            2.0 * tri.astype(dt) / jnp.maximum(denom, 1),
+            jnp.asarray(0, dt),
+        )
+        return dict(state, lcc=lcc), jnp.int32(0)
+
+    def inceval(self, ctx, frag, state):
+        return state, jnp.int32(0)
+
+    def finalize(self, frag, state):
+        return np.asarray(state["lcc"])

@@ -18,6 +18,8 @@ instead of O(E) — the win on high-diameter, low-frontier graphs.
 
 from __future__ import annotations
 
+import weakref
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -49,7 +49,6 @@ def main():
     from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
     from libgrape_lite_tpu.models import APP_REGISTRY
     from libgrape_lite_tpu.parallel.comm_spec import CommSpec
-    from libgrape_lite_tpu.utils.id_parser import IdParser
     from libgrape_lite_tpu.utils.memory import get_memory_stats
     from libgrape_lite_tpu.vertex_map.partitioner import MapPartitioner
     from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
@@ -59,12 +58,7 @@ def main():
     w = (np.abs(np.sin(src * 0.37 + dst * 0.71)) * 99 + 1).astype(np.float64)
     comm = CommSpec(fnum=args.fnum)
     oids = np.arange(n, dtype=np.int64)
-    part = MapPartitioner(comm.fnum, oids)
-    fids = part.get_partition_id(oids)
-    from libgrape_lite_tpu.vertex_map.idxer import HashMapIdxer
-
-    idxers = [HashMapIdxer(oids[fids == f]) for f in range(comm.fnum)]
-    vm = VertexMap(part, idxers, IdParser(comm.fnum, max(2, 2 * n // comm.fnum)))
+    vm = VertexMap.build(oids, MapPartitioner(comm.fnum, oids))
     t0 = time.perf_counter()
     frag = ShardedEdgecutFragment.build(comm, vm, src, dst, w, directed=False)
     print(f"build: {time.perf_counter() - t0:.2f}s  "

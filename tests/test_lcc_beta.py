@@ -1,0 +1,33 @@
+"""LCCBeta (merge-intersection LCC) vs the golden and the bitmap LCC."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import dataset_path
+from tests.test_apps_golden import run_worker
+from tests.verifiers import eps_verify, load_golden
+
+
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_lcc_beta_golden(graph_cache, fnum):
+    from libgrape_lite_tpu.models import LCCBeta
+
+    frag = graph_cache(fnum)
+    res = run_worker(LCCBeta(), frag)
+    eps_verify(res, load_golden(dataset_path("p2p-31-LCC")))
+
+
+def test_lcc_beta_tiny_sharded():
+    from libgrape_lite_tpu.models import LCCBeta
+    from libgrape_lite_tpu.worker.worker import Worker
+    from tests.test_worker import build_fragment
+
+    src = [0, 1, 0, 2]
+    dst = [1, 2, 2, 3]
+    frag = build_fragment(src, dst, None, 4, 4)
+    w = Worker(LCCBeta(), frag)
+    w.query()
+    vals = np.concatenate(
+        [w.result_values()[f, : frag.inner_vertices_num(f)] for f in range(4)]
+    )
+    np.testing.assert_allclose(vals, [1.0, 1.0, 1 / 3, 0.0], atol=1e-12)
