@@ -43,8 +43,6 @@ class SSSPMsg(AppBase):
     def __init__(self, initial_capacity: int = 1024):
         self.initial_capacity = max(1, initial_capacity)
         self.rounds = 0
-        import weakref
-
         self.retries = 0  # overflow-driven capacity regrows
         self.final_capacity = self.initial_capacity
         # fragment -> {capacity: compiled step}
