@@ -10,9 +10,10 @@ engine (`UniFragCliqueNumRecursive`); the irregular recursion has no
 profitable static-shape form, so this app runs on the *host engine*
 (numpy packed bitmaps, vectorised innermost levels) rather than the
 traced superstep path — mirroring where the reference actually executes
-it.  k=3 is fully edge-vectorised; k>=4 recurses per apex with
-vectorised leaf levels.  A Pallas device kernel for the k=3/4 cases is
-planned alongside the LCC merge-path kernel.
+it — except k=3, which runs ON-DEVICE through the merge-intersection
+kernel (models/lcc_beta.py in apex-counting mode).  k>=4 recurses per
+apex on the host with vectorised leaf levels; moving k=4 onto the same
+ELL structure is ROADMAP item 3's remainder.
 
 Output: per-apex clique counts (sum == global k-clique count, exposed
 as `worker.app.total_cliques` after a query; the reference prints only
@@ -21,10 +22,16 @@ the global count, `kclique_context.h` Output).
 
 from __future__ import annotations
 
+import weakref
+
 import numpy as np
 
 from libgrape_lite_tpu.app.base import AppBase
 from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
+
+# fragment -> Worker over the device triangle kernel, so repeated k=3
+# queries reuse the compiled step (entries self-purge with the fragment)
+_TRIANGLE_WORKERS = weakref.WeakKeyDictionary()
 
 
 def _popcount(a: np.ndarray) -> np.ndarray:
@@ -52,6 +59,22 @@ class KClique(AppBase):
             self.k = k
         k = self.k
         fnum, vp = frag.fnum, frag.vp
+
+        if k == 3:
+            # triangles run on-device through the merge-intersection
+            # kernel in apex-counting mode (ROADMAP item 3) — before
+            # any host edge materialization, which is the bottleneck
+            # this path removes
+            from libgrape_lite_tpu.models.lcc_beta import ApexTriangleCount
+            from libgrape_lite_tpu.worker.worker import Worker
+
+            if frag not in _TRIANGLE_WORKERS:
+                _TRIANGLE_WORKERS[frag] = Worker(ApexTriangleCount(), frag)
+            w = _TRIANGLE_WORKERS[frag]
+            w.query()
+            per_apex = w.result_values()
+            self.total_cliques = int(per_apex.sum())
+            return {"count": per_apex}
 
         # global (dense-compacted) oriented adjacency from the host CSRs
         v_list, u_list = [], []
@@ -94,54 +117,49 @@ class KClique(AppBase):
                 B, (vr, ur // 64), np.uint64(1) << (ur % 64).astype(np.uint64)
             )
 
-            if k == 3:
-                ch = 8192
-                for i in range(0, len(vr), ch):
-                    inter = B[vr[i : i + ch]] & B[ur[i : i + ch]]
-                    np.add.at(counts, v[i : i + ch], _popcount(inter).astype(np.int64))
-            else:
-                # adjacency (oriented out-neighbor ranks) per vertex
-                order = np.argsort(vr, kind="stable")
-                vs, us = vr[order], ur[order]
-                starts = np.searchsorted(vs, np.arange(n))
-                ends = np.searchsorted(vs, np.arange(n) + 1)
+            # k >= 4: host recursion (k == 3 returned above)
+            # adjacency (oriented out-neighbor ranks) per vertex
+            order = np.argsort(vr, kind="stable")
+            vs, us = vr[order], ur[order]
+            starts = np.searchsorted(vs, np.arange(n))
+            ends = np.searchsorted(vs, np.arange(n) + 1)
 
-                def _bits(bm: np.ndarray) -> np.ndarray:
-                    out = []
-                    for wi in np.nonzero(bm)[0]:
-                        word = int(bm[wi])
-                        while word:
-                            b = word & -word
-                            out.append(wi * 64 + b.bit_length() - 1)
-                            word ^= b
-                    return np.asarray(out, dtype=np.int64)
+            def _bits(bm: np.ndarray) -> np.ndarray:
+                out = []
+                for wi in np.nonzero(bm)[0]:
+                    word = int(bm[wi])
+                    while word:
+                        b = word & -word
+                        out.append(wi * 64 + b.bit_length() - 1)
+                        word ^= b
+                return np.asarray(out, dtype=np.int64)
 
-                def rec(cand: np.ndarray, depth: int) -> int:
-                    """Count cliques extending the current chain whose
-                    remaining candidates are `cand` (packed bitmap)."""
-                    if depth == 0:
-                        return int(_popcount(cand[None, :]).sum())
-                    members = _bits(cand)
-                    if len(members) == 0:
-                        return 0
-                    if depth == 1:
-                        inter = B[members] & cand[None, :]
-                        return int(_popcount(inter).sum())
-                    total = 0
-                    for w in members:
-                        total += rec(cand & B[w], depth - 1)
-                    return total
+            def rec(cand: np.ndarray, depth: int) -> int:
+                """Count cliques extending the current chain whose
+                remaining candidates are `cand` (packed bitmap)."""
+                if depth == 0:
+                    return int(_popcount(cand[None, :]).sum())
+                members = _bits(cand)
+                if len(members) == 0:
+                    return 0
+                if depth == 1:
+                    inter = B[members] & cand[None, :]
+                    return int(_popcount(inter).sum())
+                total = 0
+                for w in members:
+                    total += rec(cand & B[w], depth - 1)
+                return total
 
-                for apex_rank in range(n):
-                    s, e = starts[apex_rank], ends[apex_rank]
-                    if e - s < k - 1:
-                        continue
-                    cand = np.zeros(words, np.uint64)
-                    np.bitwise_or.at(
-                        cand, us[s:e] // 64,
-                        np.uint64(1) << (us[s:e] % 64).astype(np.uint64),
-                    )
-                    counts[int(used[apex_rank])] += rec(cand, k - 2)
+            for apex_rank in range(n):
+                s, e = starts[apex_rank], ends[apex_rank]
+                if e - s < k - 1:
+                    continue
+                cand = np.zeros(words, np.uint64)
+                np.bitwise_or.at(
+                    cand, us[s:e] // 64,
+                    np.uint64(1) << (us[s:e] % 64).astype(np.uint64),
+                )
+                counts[int(used[apex_rank])] += rec(cand, k - 2)
 
         self.total_cliques = int(counts.sum())
         return {"count": counts.reshape(fnum, vp)}

@@ -38,6 +38,11 @@ class LCCBeta(ParallelAppBase):
     message_strategy = MessageStrategy.kAlongOutgoingEdgeToOuterVertex
     result_format = "float"
 
+    # "lcc": full triple crediting + clustering-coefficient ratio.
+    # "apex": apex-only triangle counts (each triangle counted once at
+    # its DAG apex) — the k=3 clique-counting mode used by KClique.
+    credit_mode = "lcc"
+
     def init_state(self, frag, **_):
         """Host prep: dedup degree-oriented out-adjacency as sorted,
         padded ELL blocks (the analogue of lcc.h stage-1 neighbor
@@ -149,18 +154,19 @@ class LCCBeta(ParallelAppBase):
 
                 c1 = hit.sum(axis=1, dtype=jnp.int32)
                 v_pid = my_fid * vp + sl  # local row pid
-                u_pid = cur_fid * vp + nlid
                 cr = cr.at[jnp.where(sel, v_pid, n_pad)].add(
                     jnp.where(sel, c1, 0)
                 )
-                cr = cr.at[jnp.where(sel, u_pid, n_pad)].add(
-                    jnp.where(sel, c1, 0)
-                )
-                # far-end credits: +1 per matched member value
-                w_idx = jnp.where(hit, q, jnp.int32(n_pad))
-                cr = cr.at[w_idx.reshape(-1)].add(
-                    hit.reshape(-1).astype(jnp.int32)
-                )
+                if self.credit_mode == "lcc":
+                    u_pid = cur_fid * vp + nlid
+                    cr = cr.at[jnp.where(sel, u_pid, n_pad)].add(
+                        jnp.where(sel, c1, 0)
+                    )
+                    # far-end credits: +1 per matched member value
+                    w_idx = jnp.where(hit, q, jnp.int32(n_pad))
+                    cr = cr.at[w_idx.reshape(-1)].add(
+                        hit.reshape(-1).astype(jnp.int32)
+                    )
                 return cr
 
             return lax.fori_loop(0, n_chunks, body, carry_cred)
@@ -185,6 +191,11 @@ class LCCBeta(ParallelAppBase):
         total = ctx.sum(cred[:n_pad])
         tri = lax.dynamic_slice(total, (my_fid * vp,), (vp,))
 
+        if self.credit_mode == "apex":
+            # raw per-apex triangle counts (k=3 clique counting) stay
+            # integer end to end — float32 would round above 2^24
+            out = jnp.where(frag.inner_mask, tri, 0).astype(jnp.int32)
+            return dict(state, tri=out), jnp.int32(0)
         dt = state["lcc"].dtype
         degf = deg_local.astype(dt)
         denom = degf * (degf - 1)
@@ -200,3 +211,19 @@ class LCCBeta(ParallelAppBase):
 
     def finalize(self, frag, state):
         return np.asarray(state["lcc"])
+
+
+class ApexTriangleCount(LCCBeta):
+    """k=3 clique counting: the merge kernel in apex-only credit mode
+    with integer counts (used by models/kclique.py)."""
+
+    credit_mode = "apex"
+    result_format = "int"
+
+    def init_state(self, frag, **kw):
+        state = super().init_state(frag, **kw)
+        state["tri"] = np.zeros((frag.fnum, frag.vp), dtype=np.int32)
+        return state
+
+    def finalize(self, frag, state):
+        return np.asarray(state["tri"]).astype(np.int64)
