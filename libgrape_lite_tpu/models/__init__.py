@@ -28,7 +28,7 @@ from libgrape_lite_tpu.models.kclique import KClique
 from libgrape_lite_tpu.models.pagerank_vc import PageRankVC
 from libgrape_lite_tpu.models.lcc_directed import LCCDirected
 from libgrape_lite_tpu.models.wcc_opt import WCCOpt
-from libgrape_lite_tpu.models.sssp_msg import SSSPMsg
+from libgrape_lite_tpu.models.sssp_msg import BFSMsg, SSSPMsg
 from libgrape_lite_tpu.models.lcc_beta import LCCBeta
 from libgrape_lite_tpu.models.auto_apps import (
     BFSAuto,
@@ -45,6 +45,7 @@ APP_REGISTRY = {
     "bfs": BFS,
     "bfs_auto": BFSAuto,
     "bfs_opt": BFS,
+    "bfs_msg": BFSMsg,
     "wcc": WCC,
     "wcc_auto": WCCAuto,
     "wcc_opt": WCCOpt,

@@ -40,6 +40,16 @@ class SSSPMsg(AppBase):
     needs_edata = True
     host_only = True  # self-driving: capacity retry needs the host
 
+    @staticmethod
+    def _payload(dist_at_src, oe):
+        """Per-edge message value: relaxation candidate."""
+        return dist_at_src + oe.edge_w
+
+    @staticmethod
+    def _dist_dtype(frag):
+        dt = frag.host_oe[0].edge_w.dtype if frag.weighted else np.float32
+        return dt if np.dtype(dt).kind == "f" else np.float32
+
     def __init__(self, initial_capacity: int = 1024):
         self.initial_capacity = max(1, initial_capacity)
         self.rounds = 0
@@ -51,9 +61,7 @@ class SSSPMsg(AppBase):
     def host_compute(self, frag, source=0, max_rounds: int | None = None):
         comm_spec = frag.comm_spec
         fnum, vp = frag.fnum, frag.vp
-        dtype = frag.host_oe[0].edge_w.dtype if frag.weighted else np.float32
-
-        dist0 = np.full((fnum, vp), np.inf, dtype=dtype)
+        dist0 = np.full((fnum, vp), np.inf, dtype=self._dist_dtype(frag))
         changed0 = np.zeros((fnum, vp), dtype=bool)
         pid = resolve_source(frag, source, "SSSPMsg")
         if pid >= 0:
@@ -76,7 +84,7 @@ class SSSPMsg(AppBase):
                 valid = jnp.logical_and(
                     oe.edge_mask, ch[jnp.minimum(oe.edge_src, vp - 1)]
                 )
-                cand = src_d + oe.edge_w
+                cand = self._payload(src_d, oe)
                 dest = (oe.edge_nbr // vp).astype(jnp.int32)
                 lid = (oe.edge_nbr % vp).astype(jnp.int32)
                 rl, rp, rv, ovf = AllToAllMessageManager.exchange(
@@ -129,3 +137,31 @@ class SSSPMsg(AppBase):
 
     def finalize(self, frag, state):
         return np.asarray(state["dist"])
+
+
+class BFSMsg(SSSPMsg):
+    """BFS levels over the message-tensor path (unit-weight Bellman-Ford
+    = level-synchronous BFS; the reference `bfs.h` pushes exactly these
+    frontier messages).  Distances are float levels internally; output
+    formats as the reference's integer depths with the int64-max
+    sentinel for unreachable vertices (`bfs_context.h:44`)."""
+
+    result_format = "int"
+    needs_edata = False
+
+    @staticmethod
+    def _dist_dtype(frag):
+        # levels never depend on edge data (and must not inherit a
+        # non-float edata dtype); f32 holds exact ints to 2^24 levels
+        return np.float32
+
+    @staticmethod
+    def _payload(dist_at_src, oe):
+        return dist_at_src + 1.0
+
+    def finalize(self, frag, state):
+        d = np.asarray(state["dist"])
+        out = np.full(d.shape, np.iinfo(np.int64).max, dtype=np.int64)
+        finite = np.isfinite(d)
+        out[finite] = d[finite].astype(np.int64)
+        return out

@@ -48,3 +48,36 @@ def test_sssp_msg_honors_max_rounds(graph_cache):
     w = Worker(app, frag)
     w.query(max_rounds=3, source=6)
     assert w.rounds == 3  # bounded, not run to convergence (22 rounds)
+
+
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_bfs_msg(graph_cache, fnum):
+    from libgrape_lite_tpu.models import BFSMsg
+
+    frag = graph_cache(fnum)
+    res = run_worker(BFSMsg(), frag, source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-BFS")))
+
+
+def test_bfs_msg_directed(graph_cache):
+    from libgrape_lite_tpu.models import BFSMsg
+
+    frag = graph_cache(2, directed=True)
+    res = run_worker(BFSMsg(), frag, source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-BFS-directed")))
+
+
+def test_bfs_msg_unweighted_fragment():
+    """The runner loads bfs_msg graphs unweighted (needs_edata=False):
+    edge_w is None and the dist dtype must not derive from edata."""
+    from libgrape_lite_tpu.fragment.loader import LoadGraph, LoadGraphSpec
+    from libgrape_lite_tpu.models import BFSMsg
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+
+    frag = LoadGraph(
+        dataset_path("p2p-31.e"), dataset_path("p2p-31.v"),
+        CommSpec(fnum=2), LoadGraphSpec(weighted=False),
+    )
+    assert frag.host_oe[0].edge_w is None
+    res = run_worker(BFSMsg(), frag, source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-BFS")))
