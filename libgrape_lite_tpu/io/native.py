@@ -63,12 +63,58 @@ def _load() -> ctypes.CDLL | None:
         lib.gl_all_weighted.argtypes = [ctypes.c_void_p]
         lib.gl_free.restype = None
         lib.gl_free.argtypes = [ctypes.c_void_p]
+        try:
+            # a stale prebuilt .so may predate gl_sort_edges: degrade to
+            # parser-only rather than crashing every native call
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            lib.gl_sort_edges.restype = None
+            lib.gl_sort_edges.argtypes = [
+                i64p, i64p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                i64p, i64p, ctypes.c_void_p, i64p,
+            ]
+            lib._gl_has_sort = True
+        except AttributeError:
+            lib._gl_has_sort = False
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def sort_edges_native(src, nbr, w, num_rows: int, num_cols: int):
+    """Stable (src, nbr) counting sort + indptr via the C++ helper;
+    returns (src_sorted, nbr_sorted, w_sorted|None, indptr) or None when
+    the native library is unavailable."""
+    lib = _load()
+    if lib is None or not getattr(lib, "_gl_has_sort", False):
+        return None
+    src64 = np.ascontiguousarray(src, dtype=np.int64)
+    nbr64 = np.ascontiguousarray(nbr, dtype=np.int64)
+    n = len(src64)
+    if n:
+        # the C counting sort indexes raw ids — validate here so an
+        # upstream bug raises instead of corrupting the heap
+        if int(src64.min()) < 0 or int(src64.max()) >= num_rows:
+            raise ValueError("sort_edges_native: src id out of range")
+        if int(nbr64.min()) < 0 or int(nbr64.max()) >= num_cols:
+            raise ValueError("sort_edges_native: nbr id out of range")
+    w64 = None if w is None else np.ascontiguousarray(w, dtype=np.float64)
+    out_src = np.empty(n, dtype=np.int64)
+    out_nbr = np.empty(n, dtype=np.int64)
+    out_w = np.empty(n, dtype=np.float64) if w is not None else None
+    indptr = np.empty(num_rows + 1, dtype=np.int64)
+    lib.gl_sort_edges(
+        src64, nbr64,
+        w64.ctypes.data if w64 is not None else None,
+        n, num_rows, num_cols,
+        out_src, out_nbr,
+        out_w.ctypes.data if out_w is not None else None,
+        indptr,
+    )
+    return out_src, out_nbr, out_w, indptr
 
 
 def parse_file_native(path: str, ncols: int, weighted: bool):

@@ -162,6 +162,50 @@ Parsed* parse_file(const char* path, int ncols, int weighted, int nthreads) {
 
 extern "C" {
 
+// Stable two-pass counting sort of an edge list by (src, nbr) — the
+// CSR build's lexsort (graph/csr.py), O(E + V) instead of comparison
+// sorting.  Outputs are caller-allocated; indptr has num_rows+1 slots.
+// The analogue of the reference's two-pass buildCSR
+// (csr_edgecut_fragment_base.h:417-736).
+void gl_sort_edges(const int64_t* src, const int64_t* nbr, const double* w,
+                   int64_t n, int64_t num_rows, int64_t num_cols,
+                   int64_t* out_src, int64_t* out_nbr, double* out_w,
+                   int64_t* out_indptr) {
+  // pass 1: stable counting sort by nbr
+  std::vector<int64_t> cnt(static_cast<size_t>(num_cols) + 1, 0);
+  for (int64_t i = 0; i < n; ++i) ++cnt[nbr[i]];
+  int64_t acc = 0;
+  for (size_t c = 0; c < cnt.size(); ++c) {
+    int64_t t = cnt[c];
+    cnt[c] = acc;
+    acc += t;
+  }
+  std::vector<int64_t> tmp_src(n), tmp_nbr(n);
+  std::vector<double> tmp_w(w ? n : 0);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t p = cnt[nbr[i]]++;
+    tmp_src[p] = src[i];
+    tmp_nbr[p] = nbr[i];
+    if (w) tmp_w[p] = w[i];
+  }
+  // pass 2: stable counting sort by src (also yields indptr)
+  std::vector<int64_t> rcnt(static_cast<size_t>(num_rows) + 1, 0);
+  for (int64_t i = 0; i < n; ++i) ++rcnt[tmp_src[i]];
+  acc = 0;
+  for (size_t r = 0; r < rcnt.size(); ++r) {
+    int64_t t = rcnt[r];
+    out_indptr[r] = acc;
+    rcnt[r] = acc;
+    acc += t;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t p = rcnt[tmp_src[i]]++;
+    out_src[p] = tmp_src[i];
+    out_nbr[p] = tmp_nbr[i];
+    if (w) out_w[p] = tmp_w[i];
+  }
+}
+
 void* gl_parse(const char* path, int ncols, int weighted, int nthreads) {
   return parse_file(path, ncols, weighted, nthreads);
 }

@@ -53,3 +53,27 @@ def test_native_parser_missing_file(tmp_path):
         pytest.skip("native toolchain unavailable")
     with pytest.raises(FileNotFoundError):
         parse_file_native(str(tmp_path / "nope.e"), 2, True)
+
+
+def test_native_edge_sort_parity():
+    from libgrape_lite_tpu.io.native import available, sort_edges_native
+
+    if not available():
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(3)
+    n_rows, n_cols, e = 500, 900, 20000
+    src = rng.integers(0, n_rows, e)
+    nbr = rng.integers(0, n_cols, e)
+    w = rng.random(e)
+    out = sort_edges_native(src, nbr, w, n_rows, n_cols)
+    order = np.lexsort((nbr, src))
+    assert np.array_equal(out[0], src[order])
+    assert np.array_equal(out[1], nbr[order])
+    assert np.allclose(out[2], w[order])
+    counts = np.bincount(src, minlength=n_rows)
+    ip = np.zeros(n_rows + 1, np.int64)
+    np.cumsum(counts, out=ip[1:])
+    assert np.array_equal(out[3], ip)
+    # unweighted path
+    out2 = sort_edges_native(src, nbr, None, n_rows, n_cols)
+    assert out2[2] is None and np.array_equal(out2[0], src[order])
