@@ -282,7 +282,13 @@ class ShardedEdgecutFragment:
         # reference storing a single adjacency for undirected inputs.
         oe_counts = np.bincount(src_fid, minlength=fnum)
         ie_counts = np.bincount(dst_fid, minlength=fnum)
-        need_oe = load_strategy in (LoadStrategy.kOnlyOut, LoadStrategy.kBothOutIn)
+        # undirected kOnlyIn aliases kOnlyOut: the symmetrised CSR is
+        # the same multiset either way (see aliasing note above), so
+        # build the out stack and alias it rather than crashing on an
+        # empty host_oe/host_ie pair
+        need_oe = load_strategy in (
+            LoadStrategy.kOnlyOut, LoadStrategy.kBothOutIn
+        ) or (not directed and load_strategy == LoadStrategy.kOnlyIn)
         need_ie = directed and load_strategy in (
             LoadStrategy.kOnlyIn, LoadStrategy.kBothOutIn
         )

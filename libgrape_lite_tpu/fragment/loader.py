@@ -96,7 +96,10 @@ def LoadGraph(
         oids = read_vertex_file(vfile, string_id=spec.string_id)
     else:
         # efile-only loading (reference basic_efile_fragment_loader.h):
-        # vertex universe = endpoints, in first-appearance order
+        # vertex universe = the set of edge endpoints.  np.unique yields
+        # them in sorted oid order (NOT the reference's first-appearance
+        # order); lids therefore differ, but all output is oid-keyed so
+        # results are unaffected.
         oids = np.unique(np.concatenate([src, dst]))
 
     if spec.rebalance:

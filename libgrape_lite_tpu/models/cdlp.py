@@ -103,8 +103,10 @@ class CDLP(ParallelAppBase):
         run_id = jnp.cumsum(first.astype(jnp.int32)) - 1
         e = ss.shape[0]
         run_len = self.segment_reduce(
-            valid.astype(jnp.int32), run_id, e - 1, "sum"
-        )  # runs <= E
+            valid.astype(jnp.int32), run_id, e, "sum"
+        )  # runs <= E, so size the table with e rows — when every
+        # (src,label) pair is distinct, run_id reaches e-1 and must not
+        # land in the sliced-off overflow segment
         c_e = run_len[run_id]
 
         cmax = self.segment_reduce(c_e, ss, vp, "max")

@@ -8,31 +8,14 @@ import pytest
 
 from tests.conftest import dataset_path
 from tests.verifiers import (
+    collect_worker_result as run_worker,
     eps_verify,
     exact_verify,
     load_golden,
-    load_result_lines,
     wcc_verify,
 )
 
 FNUMS = [1, 2, 4, 8]
-
-
-def run_worker(app, frag, **kwargs):
-    from libgrape_lite_tpu.worker.worker import Worker, format_result_lines
-
-    w = Worker(app, frag)
-    w.query(**kwargs)
-    values = w.result_values()
-    chunks = []
-    for f in range(frag.fnum):
-        n = frag.inner_vertices_num(f)
-        chunks.append(
-            format_result_lines(
-                frag.inner_oids(f), values[f, :n], app.result_format
-            )
-        )
-    return load_result_lines("".join(chunks))
 
 
 @pytest.mark.parametrize("fnum", FNUMS)

@@ -1,0 +1,23 @@
+"""x64-OFF deployment-mode lane (VERDICT r1 Weak #6 / ADVICE conftest
+finding): the golden matrix runs with jax_enable_x64=True, but real TPU
+configs run x32 and float64 state silently becomes float32.  This test
+runs the core apps in a subprocess with x64 off and checks eps parity.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def test_x32_golden_parity():
+    script = os.path.join(os.path.dirname(__file__), "x32_check.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # let the script set the device count
+    env.pop("JAX_ENABLE_X64", None)  # ambient x64 would defeat the lane
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, script],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    assert r.returncode == 0, f"x32 lane failed:\n{r.stdout}\n{r.stderr}"
+    assert "X32-LANE-OK" in r.stdout

@@ -48,21 +48,46 @@ def _norm_num(s: str):
         return s
 
 
-def eps_verify(result: dict, golden: dict) -> None:
+def eps_verify(result: dict, golden: dict, eps: float = COMPARISON_THRESHOLD) -> None:
     assert result.keys() == golden.keys()
     bad = []
     for k, v in golden.items():
         g = float(v)
         r = float(result[k])
-        if g == 0:
-            ok = abs(r) < 1e-12
+        if np.isinf(g) or np.isinf(r):
+            # eps_check.cc:22 treats near-infinity specially; exact
+            # infinities must simply agree
+            ok = np.isinf(g) and np.isinf(r) and (g > 0) == (r > 0)
+        elif g == 0:
+            ok = abs(r) < max(1e-12, eps * 1e-8)
         else:
-            ok = abs(r - g) <= COMPARISON_THRESHOLD * abs(g)
+            ok = abs(r - g) <= eps * abs(g)
         if not ok:
             bad.append((k, r, g))
             if len(bad) >= 5:
                 break
     assert not bad, f"eps mismatch (first {len(bad)}): {bad}"
+
+
+def collect_worker_result(app, frag, **kwargs) -> dict:
+    """Run a query and collect its output lines as a {oid: value} dict —
+    the shared bridge between Worker.output formatting and the
+    verifiers, usable from conftest-free scripts (x32_check) and the
+    pytest lanes alike."""
+    from libgrape_lite_tpu.worker.worker import Worker, format_result_lines
+
+    w = Worker(app, frag)
+    w.query(**kwargs)
+    values = w.result_values()
+    chunks = []
+    for f in range(frag.fnum):
+        n = frag.inner_vertices_num(f)
+        chunks.append(
+            format_result_lines(
+                frag.inner_oids(f), values[f, :n], app.result_format
+            )
+        )
+    return load_result_lines("".join(chunks))
 
 
 def wcc_verify(result: dict, golden: dict) -> None:
