@@ -38,6 +38,9 @@ class CDLP(ParallelAppBase):
     def __init__(self, max_round: int = 10, label_dtype=np.int64):
         self.max_round = max_round
         self.label_dtype = label_dtype
+        # test hook: force the wide (variadic-sort) path even when the
+        # packed-uint32 key would fit
+        self._force_wide = False
 
     def init_state(self, frag, max_round: int | None = None):
         if max_round is not None:
@@ -77,7 +80,7 @@ class CDLP(ParallelAppBase):
         n_pad = vp * frag.fnum
         rank_bits = max(1, int(np.ceil(np.log2(n_pad + 2))))
         src_bits = max(1, int(np.ceil(np.log2(vp + 2))))
-        if rank_bits + src_bits <= 32:
+        if rank_bits + src_bits <= 32 and not self._force_wide:
             # labels always belong to the initial id universe, so they
             # rank into a static sorted LUT; packing (src, rank) into
             # one uint32 key lets ONE sort replace the two-key lexsort,
@@ -91,10 +94,16 @@ class CDLP(ParallelAppBase):
                 jnp.minimum(key & jnp.uint32((1 << rank_bits) - 1),
                             jnp.uint32(n_pad)).astype(jnp.int32)
             ]
-        else:  # huge-graph fallback: two-key stable sort
-            order = jnp.lexsort((lab, src))
-            ss = src[order]
-            ll = lab[order]
+        else:
+            # wide path (vertices/shard x label universe beyond the
+            # 32-bit pack): ONE variadic lexicographic sort over the
+            # (src, label) pair — `lax.sort` with num_keys=2 compares
+            # tuples directly, so no rank LUT, no permutation gather,
+            # and no second stable sort (the old lexsort fallback paid
+            # both).  Works at any label width the dtype admits.
+            from jax import lax as jlax
+
+            ss, ll = jlax.sort((src, lab), num_keys=2)
         valid = ss != jnp.int32(vp)
 
         first = jnp.ones_like(ss, dtype=bool).at[1:].set(

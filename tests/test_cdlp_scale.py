@@ -1,0 +1,120 @@
+"""CDLP wide-path coverage (VERDICT r1 Missing #6): the packed-uint32
+single-sort key caps at ~2^15 vertices/shard x 2^17 label universe;
+beyond that CDLP takes the variadic-sort path.  Two lanes:
+
+* p2p-31 with the wide path FORCED — golden-exact, proving the two
+  paths agree on the LDBC semantics;
+* RMAT-18 (2^18 vertices, naturally beyond the pack) vs an independent
+  numpy oracle of the reference's update_label_fast semantics
+  (`examples/analytical_apps/cdlp/cdlp_utils.h`), plus a
+  Counter-per-vertex spot check structurally unlike either device or
+  oracle formulation.
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from tests.conftest import dataset_path
+from tests.verifiers import collect_worker_result, exact_verify, load_golden
+
+
+def np_cdlp(n, src, dst, rounds):
+    """Host oracle: symmetric synchronous label propagation, mode over
+    neighbor labels, ties to the smallest label."""
+    s = np.concatenate([src, dst])
+    d = np.concatenate([dst, src])
+    labels = np.arange(n, dtype=np.int64)
+    for _ in range(rounds):
+        lab = labels[d]
+        order = np.lexsort((lab, s))
+        ss, ll = s[order], lab[order]
+        first = np.ones(len(ss), dtype=bool)
+        first[1:] = (ss[1:] != ss[:-1]) | (ll[1:] != ll[:-1])
+        run_id = np.cumsum(first) - 1
+        run_len = np.bincount(run_id)
+        c_e = run_len[run_id]
+        cmax = np.zeros(n, dtype=np.int64)
+        np.maximum.at(cmax, ss, c_e)
+        best = c_e == cmax[ss]
+        cs, cl = ss[best], ll[best]
+        ordc = np.lexsort((cl, cs))
+        cs, cl = cs[ordc], cl[ordc]
+        fst = np.ones(len(cs), dtype=bool)
+        fst[1:] = cs[1:] != cs[:-1]
+        new = labels.copy()
+        new[cs[fst]] = cl[fst]
+        labels = new
+    return labels
+
+
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_cdlp_wide_path_golden(graph_cache, fnum):
+    from libgrape_lite_tpu.models import CDLP
+
+    frag = graph_cache(fnum)
+    app = CDLP()
+    app._force_wide = True
+    res = collect_worker_result(app, frag, max_round=10)
+    exact_verify(res, load_golden(dataset_path("p2p-31-CDLP")))
+
+
+def test_cdlp_rmat18_beyond_pack():
+    import bench
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.models import CDLP
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.partitioner import SegmentedPartitioner
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    n, src, dst = bench.rmat_edges(18, 4, seed=11)
+    fnum = 8
+    oids = np.arange(n, dtype=np.int64)
+    vm = VertexMap.build(
+        oids, SegmentedPartitioner(fnum, oids), idxer_type="sorted_array"
+    )
+    frag = ShardedEdgecutFragment.build(
+        CommSpec(fnum=fnum), vm, src, dst, None,
+        directed=False, load_strategy=LoadStrategy.kOnlyOut,
+    )
+    # the whole point: this shape must NOT fit the 32-bit pack
+    rank_bits = int(np.ceil(np.log2(frag.vp * fnum + 2)))
+    src_bits = int(np.ceil(np.log2(frag.vp + 2)))
+    assert rank_bits + src_bits > 32
+
+    rounds = 3
+    w = Worker(CDLP(), frag)
+    w.query(max_round=rounds)
+    got = w.result_values()  # [fnum, vp]
+
+    want = np_cdlp(n, src, dst, rounds)
+    got_by_oid = np.empty(n, dtype=np.int64)
+    for f in range(fnum):
+        iv = frag.inner_vertices_num(f)
+        got_by_oid[frag.inner_oids(f)] = np.asarray(
+            got[f, :iv], dtype=np.int64
+        )
+    np.testing.assert_array_equal(got_by_oid, want)
+
+    # structurally independent spot check: per-vertex Counter mode with
+    # smallest-label tie-break, one round back from the result
+    prev = np_cdlp(n, src, dst, rounds - 1)
+    adj = {}
+    for u, v in zip(
+        np.concatenate([src, dst]).tolist(),
+        np.concatenate([dst, src]).tolist(),
+    ):
+        adj.setdefault(u, []).append(v)
+    rng = np.random.default_rng(3)
+    for u in rng.choice(n, size=200, replace=False).tolist():
+        nbrs = adj.get(u)
+        if not nbrs:
+            assert got_by_oid[u] == u  # isolated keeps its own label
+            continue
+        counts = Counter(int(prev[v]) for v in nbrs)
+        top = max(counts.values())
+        expect = min(l for l, c in counts.items() if c == top)
+        assert got_by_oid[u] == expect, f"vertex {u}"

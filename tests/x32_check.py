@@ -20,7 +20,9 @@ if "host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-assert not jax.config.jax_enable_x64  # the whole point of this lane
+# the whole point of this lane: ensure x64 is OFF even if the ambient
+# shell exported JAX_ENABLE_X64
+jax.config.update("jax_enable_x64", False)
 
 import numpy as np  # noqa: E402
 
