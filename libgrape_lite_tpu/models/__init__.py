@@ -5,10 +5,12 @@ traced `peval`/`inceval` supersteps, host-side `finalize` (Assemble).
 
 The registry mirrors the reference's app-variant names
 (`run_app.h:214-296` dispatch).  Variants that differ only by CPU-side
-execution strategy (e.g. `*_opt` = SIMD/pooled-buffer builds of the
-same algorithm) map to the same TPU implementation — XLA owns those
-concerns; variants with genuinely different communication patterns
-(`*_auto` = SyncBuffer push, `pagerank_push`) have distinct classes.
+execution strategy (e.g. SIMD/pooled-buffer builds of the same
+algorithm) map to the same TPU implementation — XLA owns those
+concerns; variants with genuinely different round/communication
+structure have distinct classes: `*_auto` (SyncBuffer push),
+`pagerank_push`, `bfs_opt` (direction-optimizing push/pull),
+`sssp_opt`/`sssp_delta` (bucketed near/far worklists).
 Exceptions: cdlp_auto / lcc_auto alias the base apps — their SyncBuffer
 is a plain mirror-overwrite (no aggregate op), which the gather model
 performs inherently, so push and pull coincide.
@@ -29,6 +31,8 @@ from libgrape_lite_tpu.models.pagerank_vc import PageRankVC
 from libgrape_lite_tpu.models.lcc_directed import LCCDirected
 from libgrape_lite_tpu.models.wcc_opt import WCCOpt
 from libgrape_lite_tpu.models.sssp_msg import BFSMsg, SSSPMsg
+from libgrape_lite_tpu.models.bfs_opt import BFSOpt
+from libgrape_lite_tpu.models.sssp_delta import SSSPDelta
 from libgrape_lite_tpu.models.lcc_beta import LCCBeta
 from libgrape_lite_tpu.models.auto_apps import (
     BFSAuto,
@@ -40,11 +44,15 @@ from libgrape_lite_tpu.models.auto_apps import (
 APP_REGISTRY = {
     "sssp": SSSP,
     "sssp_auto": SSSPAuto,
-    "sssp_opt": SSSP,
+    # sssp_opt = the reference's worklist-optimized variant
+    # (cuda/sssp/sssp.h near/far): here the bucketed delta-stepping app
+    "sssp_opt": SSSPDelta,
+    "sssp_delta": SSSPDelta,
     "sssp_msg": SSSPMsg,
     "bfs": BFS,
     "bfs_auto": BFSAuto,
-    "bfs_opt": BFS,
+    # bfs_opt = direction-optimizing push/pull (bfs/bfs_opt.h)
+    "bfs_opt": BFSOpt,
     "bfs_msg": BFSMsg,
     "wcc": WCC,
     "wcc_auto": WCCAuto,

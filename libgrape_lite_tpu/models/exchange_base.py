@@ -1,0 +1,69 @@
+"""Shared machinery for message-exchange apps (sssp_msg / bfs_opt /
+sssp_delta): per-fragment compiled-step caches keyed by capacity, and
+the overflow-retry capacity protocol — grow on overflow, remember the
+settled capacity per fragment so repeat queries skip the retry ladder
+(the reference `EstimateMessageSize` priming,
+`parallel_message_manager_opt.h`).
+
+The host loops themselves stay in each app (plain Bellman-Ford vs
+push/pull mode switching vs bucket advancement are genuinely different
+round structures); what must never diverge — capacity planning and the
+learned-capacity lifecycle — lives here.
+"""
+
+from __future__ import annotations
+
+import weakref
+
+import jax.numpy as jnp
+
+from libgrape_lite_tpu.app.base import AppBase
+from libgrape_lite_tpu.ops.segment import segment_reduce
+from libgrape_lite_tpu.parallel.message_manager import AllToAllMessageManager
+
+
+def exchange_relax(oe, cand, valid, cap: int, fnum: int, vp: int, neutral):
+    """Route per-edge candidates to their owners and min-reduce into
+    [vp] rows — the shared push-relax step of sssp_msg / bfs_opt /
+    sssp_delta.  `neutral` fills invalid receive slots (inf for
+    distances, the int sentinel for levels).  Returns (relaxed [vp],
+    overflow_vote)."""
+    dest = (oe.edge_nbr // vp).astype(jnp.int32)
+    lid = (oe.edge_nbr % vp).astype(jnp.int32)
+    rl, rp, rv, ovf = AllToAllMessageManager.exchange(
+        dest, lid, cand, valid, cap, fnum
+    )
+    relaxed = segment_reduce(
+        jnp.where(rv, rp, neutral),
+        jnp.where(rv, rl, jnp.int32(vp)),
+        vp, "min", sorted_ids=False,
+    )
+    return relaxed, ovf
+
+
+class ExchangeAppBase(AppBase):
+    host_only = True  # data-dependent host loops (capacity retry, modes)
+
+    def __init__(self, initial_capacity: int | None = None):
+        # None = derive from the graph at query time via
+        # plan_initial_capacity (message_manager.py)
+        self.initial_capacity = initial_capacity
+        self.rounds = 0
+        self.retries = 0  # overflow-driven capacity regrows
+        self.final_capacity = initial_capacity or 1024
+        # fragment -> {capacity: compiled step(s)}
+        self._cache = weakref.WeakKeyDictionary()
+        self._learned_cap = weakref.WeakKeyDictionary()
+
+    def _initial_cap(self, frag) -> int:
+        from libgrape_lite_tpu.parallel.message_manager import (
+            plan_initial_capacity,
+        )
+
+        return plan_initial_capacity(
+            frag, self.initial_capacity, self._learned_cap
+        )
+
+    def _save_cap(self, frag, cap: int) -> None:
+        self.final_capacity = cap
+        self._learned_cap[frag] = cap

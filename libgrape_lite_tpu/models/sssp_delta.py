@@ -1,0 +1,176 @@
+"""SSSPDelta — bucketed (delta-stepping style) SSSP.
+
+Re-design of the reference's near/far worklist SSSP
+(`examples/analytical_apps/cuda/sssp/sssp.h:70-124`, also `sssp_opt.h`):
+instead of relaxing from EVERY improved vertex each round (plain
+Bellman-Ford, `sssp_msg.py`), vertices with pending improvements are
+bucketed by distance.  Only the *near* set — pending vertices with
+dist < threshold — pushes; far improvements wait.  When the near set
+drains, the threshold advances to the next non-empty bucket.  This
+bounds wasted relaxations from provisional (still-shrinking) distances:
+a vertex usually pushes once, with its (near-)final distance, instead
+of once per improvement.
+
+TPU formulation: the same message-tensor exchange as `sssp_msg.py`
+(fixed-capacity all_to_all + overflow-vote retry); the threshold is a
+traced scalar argument so bucket advances don't retrace.  The host
+drives the loop — bucket advancement is data-dependent (it reads the
+psum'd near/pending counts and the pmin of pending distances), exactly
+the role of the reference's host-side worklist swap.
+
+Unlike classic delta-stepping there is no light/heavy edge split: TPU
+relaxes all out-edges of a near vertex in one edge-parallel sweep (the
+split only pays when heavy edges can be deferred per-edge, which a
+dense edge tensor cannot).  Convergence and exactness are unaffected —
+the result equals Bellman-Ford's fixed point.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from libgrape_lite_tpu.app.base import resolve_source
+from libgrape_lite_tpu.models.exchange_base import (
+    ExchangeAppBase,
+    exchange_relax,
+)
+from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
+from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
+
+
+class SSSPDelta(ExchangeAppBase):
+    load_strategy = LoadStrategy.kBothOutIn
+    message_strategy = MessageStrategy.kAlongEdgeToOuterVertex
+    result_format = "sssp_infinity"
+    needs_edata = True
+
+    def __init__(self, delta: float | None = None,
+                 initial_capacity: int | None = None):
+        super().__init__(initial_capacity)
+        self.delta = delta  # None = mean edge weight at query time
+        self.buckets = 0
+        import weakref
+
+        self._delta_cache = weakref.WeakKeyDictionary()
+
+    @staticmethod
+    def _dist_dtype(frag):
+        dt = frag.host_oe[0].edge_w.dtype if frag.weighted else np.float32
+        return dt if np.dtype(dt).kind == "f" else np.float32
+
+    def _resolve_delta(self, frag) -> float:
+        if self.delta is not None and self.delta > 0:
+            return float(self.delta)
+        if frag in self._delta_cache:
+            return self._delta_cache[frag]
+        # heuristic: mean positive edge weight — buckets then hold
+        # roughly one extra hop each (the reference tunes its near/far
+        # boundary the same order of magnitude).  O(E) host scan, so the
+        # result is cached per (immutable) fragment.
+        w = frag.host_oe[0].edge_w
+        if w is None:
+            return 1.0
+        total, count = 0.0, 0
+        for c in frag.host_oe:
+            if c.edge_w is not None and c.num_edges:
+                total += float(c.edge_w[c.edge_mask].sum())
+                count += int(c.num_edges)
+        delta = max(total / count, 1e-6) if count else 1.0
+        self._delta_cache[frag] = delta
+        return delta
+
+    def _step_for(self, frag, cap: int):
+        per_frag = self._cache.setdefault(frag, {})
+        if cap in per_frag:
+            return per_frag[cap]
+
+        comm_spec = frag.comm_spec
+        fnum, vp = frag.fnum, frag.vp
+
+        def step(frag_stacked, dist, pending, thr):
+            lf = frag_stacked.local()
+            d, pend = dist[0], pending[0]
+            inf = jnp.asarray(jnp.inf, d.dtype)
+            near = jnp.logical_and(pend, d < thr)
+            oe = lf.oe
+            src = jnp.minimum(oe.edge_src, vp - 1)
+            valid = jnp.logical_and(oe.edge_mask, near[src])
+            cand = d[src] + oe.edge_w
+            relaxed, ovf = exchange_relax(oe, cand, valid, cap, fnum, vp, inf)
+            new = jnp.minimum(d, relaxed)
+            improved = jnp.logical_and(new < d, lf.inner_mask)
+            pend2 = jnp.logical_or(jnp.logical_and(pend, ~near), improved)
+            n_near = lax.psum(near.sum().astype(jnp.int32), FRAG_AXIS)
+            n_pend = lax.psum(pend2.sum().astype(jnp.int32), FRAG_AXIS)
+            min_pend = lax.pmin(
+                jnp.where(pend2, new, inf).min(), FRAG_AXIS
+            )
+            return new[None], pend2[None], n_near, n_pend, min_pend, ovf
+
+        fn = jax.jit(
+            jax.shard_map(
+                step, mesh=comm_spec.mesh,
+                in_specs=(P(FRAG_AXIS), P(FRAG_AXIS), P(FRAG_AXIS), P()),
+                out_specs=(P(FRAG_AXIS), P(FRAG_AXIS), P(), P(), P(), P()),
+                check_vma=False,
+            )
+        )
+        per_frag[cap] = fn
+        return fn
+
+    def host_compute(self, frag, source=0, max_rounds: int | None = None):
+        fnum, vp = frag.fnum, frag.vp
+        dt = np.dtype(self._dist_dtype(frag))
+        dist0 = np.full((fnum, vp), np.inf, dtype=dt)
+        pend0 = np.zeros((fnum, vp), dtype=bool)
+        pid = resolve_source(frag, source, "SSSPDelta")
+        if pid >= 0:
+            dist0[pid // vp, pid % vp] = 0.0
+            pend0[pid // vp, pid % vp] = True
+
+        delta = self._resolve_delta(frag)
+        dist = jnp.asarray(dist0)
+        pending = jnp.asarray(pend0)
+        thr = delta
+        cap = self._initial_cap(frag)
+        self.rounds = self.retries = self.buckets = 0
+        limit = max_rounds if (max_rounds and max_rounds > 0) else None
+        n_pend = 1 if pid >= 0 else 0
+        while n_pend > 0 and (limit is None or self.rounds < limit):
+            out = self._step_for(frag, cap)(
+                frag.dev, dist, pending, jnp.asarray(thr, dt)
+            )
+            new_dist, new_pend, n_near, n_pend_d, min_pend, ovf = out
+            if int(ovf) > 0:
+                cap *= 2
+                self.retries += 1
+                continue
+            if int(n_near) == 0:
+                # near set empty but work remains: advance to the bucket
+                # holding the smallest pending distance (skipping empty
+                # buckets — the reference's worklist swap).  The new
+                # threshold must exceed min_pend IN THE DIST DTYPE:
+                # with a tiny delta and large distances the bucket
+                # arithmetic can round back to <= min_pend in float32,
+                # which would spin forever — clamp to the next
+                # representable value above min_pend.
+                mp = float(min_pend)
+                if not np.isfinite(mp):
+                    break
+                thr = (np.floor(mp / delta) + 1.0) * delta
+                if float(np.asarray(thr, dt)) <= mp:
+                    thr = float(np.nextafter(dt.type(mp), dt.type(np.inf)))
+                self.buckets += 1
+                continue
+            dist, pending = new_dist, new_pend
+            n_pend = int(n_pend_d)
+            self.rounds += 1
+        self._save_cap(frag, cap)
+        return {"dist": dist}
+
+    def finalize(self, frag, state):
+        return np.asarray(state["dist"])

@@ -18,27 +18,26 @@ instead of O(E) — the win on high-diameter, low-frontier graphs.
 
 from __future__ import annotations
 
-import weakref
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from libgrape_lite_tpu.app.base import AppBase, resolve_source
-from libgrape_lite_tpu.ops.segment import segment_reduce
+from libgrape_lite_tpu.app.base import resolve_source
+from libgrape_lite_tpu.models.exchange_base import (
+    ExchangeAppBase,
+    exchange_relax,
+)
 from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
-from libgrape_lite_tpu.parallel.message_manager import AllToAllMessageManager
 from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
 
 
-class SSSPMsg(AppBase):
+class SSSPMsg(ExchangeAppBase):
     load_strategy = LoadStrategy.kBothOutIn
     message_strategy = MessageStrategy.kAlongEdgeToOuterVertex
     result_format = "sssp_infinity"
     needs_edata = True
-    host_only = True  # self-driving: capacity retry needs the host
 
     @staticmethod
     def _payload(dist_at_src, oe):
@@ -49,14 +48,6 @@ class SSSPMsg(AppBase):
     def _dist_dtype(frag):
         dt = frag.host_oe[0].edge_w.dtype if frag.weighted else np.float32
         return dt if np.dtype(dt).kind == "f" else np.float32
-
-    def __init__(self, initial_capacity: int = 1024):
-        self.initial_capacity = max(1, initial_capacity)
-        self.rounds = 0
-        self.retries = 0  # overflow-driven capacity regrows
-        self.final_capacity = self.initial_capacity
-        # fragment -> {capacity: compiled step}
-        self._round_cache = weakref.WeakKeyDictionary()
 
     def host_compute(self, frag, source=0, max_rounds: int | None = None):
         comm_spec = frag.comm_spec
@@ -72,7 +63,7 @@ class SSSPMsg(AppBase):
             # persistent across queries (the Worker._runner_cache
             # pattern): WeakKeyDictionary keyed on the fragment, so a
             # recycled id can never alias and dead entries self-purge
-            per_frag = self._round_cache.setdefault(frag, {})
+            per_frag = self._cache.setdefault(frag, {})
             if cap in per_frag:
                 return per_frag[cap]
 
@@ -85,16 +76,9 @@ class SSSPMsg(AppBase):
                     oe.edge_mask, ch[jnp.minimum(oe.edge_src, vp - 1)]
                 )
                 cand = self._payload(src_d, oe)
-                dest = (oe.edge_nbr // vp).astype(jnp.int32)
-                lid = (oe.edge_nbr % vp).astype(jnp.int32)
-                rl, rp, rv, ovf = AllToAllMessageManager.exchange(
-                    dest, lid, cand, valid, cap, fnum
-                )
                 inf = jnp.asarray(jnp.inf, d.dtype)
-                relaxed = segment_reduce(
-                    jnp.where(rv, rp, inf),
-                    jnp.where(rv, rl, jnp.int32(vp)),
-                    vp, "min", sorted_ids=False,
+                relaxed, ovf = exchange_relax(
+                    oe, cand, valid, cap, fnum, vp, inf
                 )
                 new = jnp.minimum(d, relaxed)
                 ch2 = jnp.logical_and(new < d, lf.inner_mask)
@@ -114,7 +98,7 @@ class SSSPMsg(AppBase):
 
         dist = jnp.asarray(dist0)
         changed = jnp.asarray(changed0)
-        cap = self.initial_capacity
+        cap = self._initial_cap(frag)
         self.rounds = 0
         self.retries = 0
         limit = max_rounds if (max_rounds and max_rounds > 0) else None
@@ -132,7 +116,7 @@ class SSSPMsg(AppBase):
             dist, changed = new_dist, new_changed
             active = int(active_d)
             self.rounds += 1
-        self.final_capacity = cap
+        self._save_cap(frag, cap)
         return {"dist": dist}
 
     def finalize(self, frag, state):

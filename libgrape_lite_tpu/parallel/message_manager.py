@@ -141,3 +141,24 @@ class AllToAllMessageManager(MessageManagerBase):
             recv_val.reshape(-1),
             overflowed,
         )
+
+
+def plan_initial_capacity(frag, requested: int | None, learned) -> int:
+    """Initial per-destination message capacity for the exchange path —
+    the role of the reference's `EstimateMessageSize` priming
+    (`parallel_message_manager_opt.h`): `requested` wins; else the
+    capacity a previous query on this fragment settled at (`learned` is
+    the app's per-fragment WeakKeyDictionary); else a graph-informed
+    floor — the densest vertex must be able to push all its edges to a
+    single destination shard without overflowing round one."""
+    if requested:
+        return max(1, requested)
+    if frag in learned:
+        return learned[frag]
+    max_deg = max(
+        int(np.diff(c.indptr).max(initial=1)) for c in frag.host_oe
+    )
+    cap = 1024
+    while cap < 2 * max_deg:
+        cap *= 2
+    return cap

@@ -1,0 +1,118 @@
+"""Round-structure comparison: dense pull vs message-path vs the
+optimized frontier apps (VERDICT r2 item 3 'done' artifact).
+
+Usage:
+    python scripts/frontier_compare.py [--scale N] [--platform cpu|default]
+
+Prints one JSON line per (graph, app) with rounds + wall-clock; run on
+TPU for the real numbers, CPU gives the round-structure story.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=18)
+    ap.add_argument("--edge_factor", type=int, default=16)
+    ap.add_argument("--platform", default="default")
+    ap.add_argument("--fnum", type=int, default=1)
+    args = ap.parse_args()
+
+    if args.platform != "default":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    import bench
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.fragment.loader import LoadGraph, LoadGraphSpec
+    from libgrape_lite_tpu.models import (
+        BFS,
+        BFSMsg,
+        BFSOpt,
+        SSSP,
+        SSSPDelta,
+        SSSPMsg,
+    )
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.partitioner import SegmentedPartitioner
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    graphs = {}
+
+    # p2p-31 (weighted, the golden graph)
+    root = os.path.join(os.path.dirname(__file__), "..")
+    spec = LoadGraphSpec(directed=False, weighted=True,
+                         edata_dtype=np.float64)
+    graphs["p2p-31"] = LoadGraph(
+        os.path.join(root, "dataset", "p2p-31.e"),
+        os.path.join(root, "dataset", "p2p-31.v"),
+        CommSpec(fnum=args.fnum), spec,
+    )
+
+    # RMAT (unit weights for BFS; weighted uniform for SSSP)
+    n, src, dst = bench.rmat_edges(args.scale, args.edge_factor)
+    rng = np.random.default_rng(3)
+    w = rng.uniform(1.0, 100.0, size=len(src))
+    oids = np.arange(n, dtype=np.int64)
+    vm = VertexMap.build(
+        oids, SegmentedPartitioner(args.fnum, oids),
+        idxer_type="sorted_array",
+    )
+    graphs[f"rmat{args.scale}"] = ShardedEdgecutFragment.build(
+        CommSpec(fnum=args.fnum), vm, src, dst, w,
+        directed=False, load_strategy=LoadStrategy.kBothOutIn,
+    )
+
+    apps = [
+        ("bfs_dense", lambda: BFS(), {"source": 6}),
+        ("bfs_msg", lambda: BFSMsg(), {"source": 6}),
+        ("bfs_opt", lambda: BFSOpt(), {"source": 6}),
+        ("sssp_dense", lambda: SSSP(), {"source": 6}),
+        ("sssp_msg", lambda: SSSPMsg(), {"source": 6}),
+        ("sssp_delta", lambda: SSSPDelta(), {"source": 6}),
+    ]
+
+    for gname, frag in graphs.items():
+        for aname, mk, kw in apps:
+            app = mk()
+            w0 = Worker(app, frag)
+            t0 = time.perf_counter()
+            w0.query(**kw)
+            cold = time.perf_counter() - t0
+            app2 = mk()
+            w1 = Worker(app2, frag)
+            w1.query(**kw)  # compile cache warm inside app instance? no:
+            # fresh app -> fresh cache; warm = re-query the same worker
+            t0 = time.perf_counter()
+            w1.query(**kw)
+            warm = time.perf_counter() - t0
+            rec = {
+                "graph": gname,
+                "app": aname,
+                "rounds": w1.rounds,
+                "cold_s": round(cold, 4),
+                "warm_s": round(warm, 4),
+            }
+            for extra in ("push_rounds", "pull_rounds", "buckets",
+                          "retries", "final_capacity"):
+                if hasattr(app2, extra):
+                    rec[extra] = getattr(app2, extra)
+            print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

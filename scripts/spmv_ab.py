@@ -1,0 +1,114 @@
+"""A/B: strict-tile Pallas SpMV vs XLA segment-sum, per graph shape.
+
+Run ON TPU (the whole point — interpret-mode numbers are meaningless):
+
+    python scripts/spmv_ab.py [--scale 20] [--tile 2048]
+
+Prints one JSON line per (graph, path) and a crossover verdict; commit
+the output into docs/PERF_NOTES.md (VERDICT r1 next-round item 2 wants
+the measured crossover table in-repo).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def bench_one(name, src_np, vals_np, vp, tile, iters=20):
+    import jax
+    import jax.numpy as jnp
+
+    from libgrape_lite_tpu.ops.segment import segment_reduce
+    from libgrape_lite_tpu.ops.spmv import (
+        plan_tiles,
+        spmv_strict,
+        strict_worthwhile,
+    )
+
+    src = jnp.asarray(src_np)
+    vals = jnp.asarray(vals_np)
+    row_lo, rmax, num_tiles = plan_tiles(src_np, tile, vp)
+
+    xla = jax.jit(lambda v, s: segment_reduce(v, s, vp, "sum"))
+    xla(vals, src).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r_xla = xla(vals, src)
+    r_xla.block_until_ready()
+    t_xla = (time.perf_counter() - t0) / iters
+
+    spmv_strict(vals, src, row_lo, vp, tile, rmax).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r_pl = spmv_strict(vals, src, row_lo, vp, tile, rmax)
+    r_pl.block_until_ready()
+    t_pl = (time.perf_counter() - t0) / iters
+
+    import numpy as np
+
+    err = float(
+        np.abs(np.asarray(r_pl) - np.asarray(r_xla)).max()
+        / max(np.abs(np.asarray(r_xla)).max(), 1e-9)
+    )
+    rec = {
+        "graph": name,
+        "edges": len(src_np),
+        "rmax": rmax,
+        "tile": tile,
+        "xla_ms": round(t_xla * 1e3, 4),
+        "pallas_ms": round(t_pl * 1e3, 4),
+        "speedup": round(t_xla / t_pl, 3),
+        "planner_says": "pallas" if strict_worthwhile(rmax, tile) else "xla",
+        "rel_err": err,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=20)
+    ap.add_argument("--edge_factor", type=int, default=16)
+    ap.add_argument("--tile", type=int, default=2048)
+    ap.add_argument("--platform", default="default")
+    args = ap.parse_args()
+
+    if args.platform != "default":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    import bench
+    from libgrape_lite_tpu.graph.csr import build_csr
+
+    n, src, dst = bench.rmat_edges(args.scale, args.edge_factor)
+    rng = np.random.default_rng(0)
+
+    # hub-heavy: RMAT sorted by row (CSR order)
+    order = np.argsort(src, kind="stable")
+    src_s = src[order].astype(np.int32)
+    vals = rng.normal(size=len(src_s)).astype(np.float32)
+    bench_one(f"rmat{args.scale}", src_s, vals, n, args.tile)
+
+    # uniform degree-16
+    usrc = np.repeat(np.arange(n, dtype=np.int32), args.edge_factor)
+    uvals = rng.normal(size=len(usrc)).astype(np.float32)
+    bench_one(f"uniform{args.scale}x{args.edge_factor}", usrc, uvals, n,
+              args.tile)
+
+    # degree-1 tail (worst case for the indicator matmul)
+    tsrc = np.arange(n, dtype=np.int32)
+    tvals = rng.normal(size=n).astype(np.float32)
+    bench_one(f"degree1_{args.scale}", tsrc, tvals, n, args.tile)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Strict-tile Pallas SpMV vs the XLA segment-sum path (interpret mode
+on CPU; the on-TPU A/B lives in scripts/spmv_ab.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from libgrape_lite_tpu.ops.segment import segment_reduce
+from libgrape_lite_tpu.ops.spmv import plan_tiles, spmv_strict
+
+
+def _case(n_rows, degrees, seed=0):
+    rng = np.random.default_rng(seed)
+    src = np.repeat(np.arange(n_rows), degrees)
+    vals = rng.normal(size=len(src)).astype(np.float32)
+    return src.astype(np.int32), vals
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [
+        ("hub", 8, [4000, 1000, 500, 100, 50, 20, 10, 4]),
+        ("uniform", 64, [16] * 64),
+        ("mixed", 32, [512] + [3] * 31),
+    ],
+    ids=lambda s: s[0],
+)
+def test_spmv_strict_matches_segment_sum(shape):
+    _, n_rows, degrees = shape
+    src, vals = _case(n_rows, degrees)
+    vp = n_rows + 1  # leave an empty row to check zero-fill
+    tile = 512
+    # pad edges to the tile grid with overflow rows (vp)
+    row_lo, rmax, num_tiles = plan_tiles(src, tile, vp)
+
+    got = spmv_strict(
+        jnp.asarray(vals), jnp.asarray(src), row_lo, vp, tile, rmax,
+        interpret=True,
+    )
+    want = segment_reduce(jnp.asarray(vals), jnp.asarray(src), vp, "sum")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_spmv_strict_with_padded_edges():
+    src, vals = _case(16, [32] * 16)
+    vp = 16
+    # simulate CSR padding: pad rows carry src == vp, value garbage
+    src_p = np.concatenate([src, np.full(100, vp, np.int32)])
+    vals_p = np.concatenate([vals, np.full(100, 7.7, np.float32)])
+    row_lo, rmax, num_tiles = plan_tiles(src_p, 256, vp)
+    got = spmv_strict(
+        jnp.asarray(vals_p * (src_p != vp)), jnp.asarray(src_p), row_lo,
+        vp, 256, rmax, interpret=True,
+    )
+    want = segment_reduce(jnp.asarray(vals), jnp.asarray(src), vp, "sum")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_plan_tiles_spans():
+    src = np.array([0, 0, 0, 1, 1, 2, 5, 5, 5, 5], dtype=np.int32)
+    row_lo, rmax, nt = plan_tiles(src, 4, 6)
+    assert nt == 3
+    np.testing.assert_array_equal(row_lo, [0, 1, 5])
+    assert rmax >= 2  # lane-aligned to 128 in practice
