@@ -28,6 +28,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--pr_d", type=float, default=0.85)
     p.add_argument("--pr_mr", type=int, default=10)
     p.add_argument("--cdlp_mr", type=int, default=10)
+    p.add_argument("--degree_threshold", type=int, default=0,
+                   help="LCC hub cap: skip neighbor lists of vertices "
+                        "above this degree (flags.cc:39; 0 = disabled)")
     p.add_argument("--fnum", type=int, default=None,
                    help="fragment count (default: all local devices)")
     p.add_argument("--partitioner_type", default="map",

@@ -51,7 +51,11 @@ class LCC(ParallelAppBase):
     result_format = "float"
     replicated_keys = frozenset()
 
-    def init_state(self, frag, **_):
+    def init_state(self, frag, degree_threshold: int = 0, **_):
+        # degree_threshold > 0 skips hub vertices' neighbor lists — the
+        # reference's cost cap (`lcc.h:234-243` filterByDegree, flag
+        # default INT_MAX i.e. disabled; 0 here means disabled too)
+        self.degree_threshold = int(degree_threshold)
         return {
             "lcc": np.zeros((frag.fnum, frag.vp), dtype=np.float64),
         }
@@ -108,6 +112,13 @@ class LCC(ParallelAppBase):
                     d_row < d_nbr,
                     jnp.logical_and(d_nbr == d_row, row_pid < csr.edge_nbr),
                 )
+            thr = getattr(self, "degree_threshold", 0)
+            if thr > 0:
+                # a filtered vertex contributes no N+ list (lcc.h:98,164):
+                # drop rows of filtered list owners — the list owner is
+                # the row vertex when orienting row→nbr, the nbr otherwise
+                owner_deg = d_row if toward_nbr else d_nbr
+                k = jnp.logical_and(k, owner_deg <= thr)
             return jnp.logical_and(self._dedup_mask(csr), k)
 
         keep_oe = oriented(oe, True)   # v(row) → u(nbr):  u ∈ N+(v)

@@ -43,10 +43,14 @@ class LCCBeta(ParallelAppBase):
     # its DAG apex) — the k=3 clique-counting mode used by KClique.
     credit_mode = "lcc"
 
-    def init_state(self, frag, **_):
+    def init_state(self, frag, degree_threshold: int = 0, **_):
         """Host prep: dedup degree-oriented out-adjacency as sorted,
         padded ELL blocks (the analogue of lcc.h stage-1 neighbor
-        filtering, done once against the host CSRs)."""
+        filtering, done once against the host CSRs).
+
+        degree_threshold > 0 drops filtered (hub) vertices' lists — the
+        reference's LCC cost cap (`lcc.h:234-243`, 0 = disabled)."""
+        self.degree_threshold = int(degree_threshold)
         fnum, vp = frag.fnum, frag.vp
         n_pad = fnum * vp
         sent = n_pad  # sorts last, never matches a valid query
@@ -69,6 +73,8 @@ class LCCBeta(ParallelAppBase):
             v, u = pairs[:, 0], pairs[:, 1]
             keep = (deg[u] < deg[v]) | ((deg[u] == deg[v]) & (u < v))
             keep &= u != v
+            if self.degree_threshold > 0:
+                keep &= deg[v] <= self.degree_threshold
             v, u = v[keep], u[keep]
             lid = (v - f * vp).astype(np.int64)
             cnt = np.bincount(lid, minlength=vp).astype(np.int32)
@@ -116,6 +122,10 @@ class LCCBeta(ParallelAppBase):
         )
         keep = jnp.logical_and(LCC._dedup_mask(oe), keep)
         keep = jnp.logical_and(keep, oe.edge_nbr != row_pid)
+        if self.degree_threshold > 0:
+            # filtered v enumerates no oriented edges; a filtered middle
+            # u's ELL row is already empty (host build dropped it)
+            keep = jnp.logical_and(keep, d_row <= self.degree_threshold)
 
         ep = oe.edge_src.shape[0]
         # chunk size bounded so chunk*d stays ~4M int32 entries

@@ -33,7 +33,10 @@ class LCCDirected(ParallelAppBase):
     message_strategy = MessageStrategy.kAlongOutgoingEdgeToOuterVertex
     result_format = "float"
 
-    def init_state(self, frag, **_):
+    def init_state(self, frag, degree_threshold: int = 0, **_):
+        # hub cap like the undirected app; directed degree = out + in
+        # with multiplicity (reference lcc.h:234-238)
+        self.degree_threshold = int(degree_threshold)
         return {"lcc": np.zeros((frag.fnum, frag.vp), dtype=np.float64)}
 
     def peval(self, ctx: StepContext, frag, state):
@@ -59,6 +62,9 @@ class LCCDirected(ParallelAppBase):
         )
         keep_nb = jnp.logical_and(msk, ~dup)
 
+        thr = getattr(self, "degree_threshold", 0)
+        deg_dir = frag.out_degree + frag.in_degree  # raw, with multiplicity
+
         # OUT: dedup directed out-adjacency (self-loops dropped)
         o_row_pid = base_pid + jnp.minimum(oe.edge_src, vp - 1)
         o_msk = jnp.logical_and(oe.edge_mask, oe.edge_nbr != o_row_pid)
@@ -69,6 +75,15 @@ class LCCDirected(ParallelAppBase):
             )
         )
         keep_out = jnp.logical_and(o_msk, ~o_dup)
+        if thr > 0:
+            # a filtered vertex contributes no neighbor list: drop its
+            # NB row (apex) and its OUT row (middle), lcc.h:98,164
+            keep_nb = jnp.logical_and(
+                keep_nb, deg_dir[jnp.minimum(src, vp - 1)] <= thr
+            )
+            keep_out = jnp.logical_and(
+                keep_out, deg_dir[jnp.minimum(oe.edge_src, vp - 1)] <= thr
+            )
 
         from libgrape_lite_tpu.models.lcc import LCC
 

@@ -79,6 +79,10 @@ def build_query_kwargs(app_name: str, args: QueryArgs) -> dict:
         return {"k": args.kclique_k}
     if app_name.startswith("pagerank"):
         return {"delta": args.pr_d, "max_round": args.pr_mr}
+    if app_name.startswith("lcc"):
+        # hub cost cap (reference FLAGS_degree_threshold, lcc.h:234-243);
+        # 0 = disabled (the reference's INT_MAX default)
+        return {"degree_threshold": args.degree_threshold}
     if app_name.startswith("cdlp"):
         return {"max_round": args.cdlp_mr}
     return {}

@@ -50,7 +50,7 @@ def main(argv=None):
     p.add_argument("--algorithms", default=",".join(ALGOS))
     p.add_argument("--source", type=int, default=6)
     p.add_argument("--report", default="ldbc_report.json")
-    p.add_argument("--runs", type=int, default=1)
+    p.add_argument("--runs", type=int, default=3)
     args = p.parse_args(argv)
 
     if args.cpu_devices:
@@ -103,29 +103,45 @@ def main(argv=None):
         worker.query(**kw)  # includes compile
         cold = time.perf_counter() - t0
         # processing_s = best of `runs` warm runs (cold run excluded,
-        # like Graphalytics' makespan vs processing split)
-        best = float("inf")
+        # like Graphalytics' makespan vs processing split).  Every query
+        # blocks on the result (Worker.query -> block_until_ready), so a
+        # warm run exceeding the cold makespan can only be host-load
+        # noise — the full warm list is recorded so a single noisy
+        # sample is visible instead of silently reported as the metric.
+        warm = []
         for _ in range(max(1, args.runs)):
             t0 = time.perf_counter()
             worker.query(**kw)
-            best = min(best, time.perf_counter() - t0)
+            warm.append(time.perf_counter() - t0)
         entry = {
             "makespan_cold_s": round(cold, 4),
-            "processing_s": round(best, 4),
+            "processing_s": round(min(warm), 4),
+            "warm_runs_s": [round(w, 4) for w in warm],
             "rounds": worker.rounds,
         }
+        if min(warm) > cold:
+            entry["timer_note"] = (
+                "warm > cold despite blocked timing: host-load noise"
+            )
 
-        if args.validation_dir:
-            suffix = {
-                "bfs": "BFS", "pagerank": "PR", "wcc": "WCC",
-                "cdlp": "CDLP", "lcc": "LCC", "sssp": "SSSP",
-            }[name]
+        suffix_map = {
+            "bfs": "BFS", "pagerank": "PR", "wcc": "WCC",
+            "cdlp": "CDLP", "lcc": "LCC", "sssp": "SSSP",
+        }
+        base = name.split("_")[0]  # same-result variants share the golden
+        # pagerank_local* are a genuinely different algorithm
+        # (competitor-compatible convergence, Performance.md:61-67) and
+        # can never match the standard PR golden
+        if name.startswith("pagerank_local"):
+            base = None
+        if args.validation_dir and base in suffix_map:
+            suffix = suffix_map[base]
             golden_path = os.path.join(
                 args.validation_dir, f"{args.dataset_name}-{suffix}"
             )
             if os.path.exists(golden_path):
                 entry["validated"] = _validate(
-                    worker, frag_w, name, golden_path, format_result_lines
+                    worker, frag_w, base, golden_path, format_result_lines
                 )
         report["results"][name] = entry
         print(f"{name}: {entry}")

@@ -1,0 +1,83 @@
+"""--degree_threshold LCC parity (reference `lcc.h:234-243` filterByDegree
++ FLAGS_degree_threshold, `flags.cc:39`): vertices with degree above the
+threshold build no oriented neighbor list, so a triangle is counted iff
+its apex v and middle u are both unfiltered (the far end w is exempt —
+it only needs membership, `lcc.h:172-179`)."""
+
+import numpy as np
+import pytest
+
+from tests.test_worker import build_fragment
+from tests.verifiers import collect_worker_result
+
+
+def er_graph(n=48, p=0.15, seed=3):
+    rng = np.random.default_rng(seed)
+    a = rng.random((n, n)) < p
+    a = np.triu(a, 1)
+    src, dst = np.nonzero(a)
+    return src.astype(np.int64), dst.astype(np.int64)
+
+
+def brute_force_lcc(frag, n, src, dst, thr):
+    """Reference-semantics LCC with the degree filter, on pids."""
+    pid = frag.oid_to_pid(np.arange(n, dtype=np.int64))
+    adj = {int(p): set() for p in pid}
+    for s, d in zip(pid[src], pid[dst]):
+        adj[int(s)].add(int(d))
+        adj[int(d)].add(int(s))
+    deg = {v: len(ns) for v, ns in adj.items()}
+
+    def nplus(v):
+        if thr > 0 and deg[v] > thr:
+            return set()
+        return {
+            u for u in adj[v]
+            if deg[u] < deg[v] or (deg[u] == deg[v] and u < v)
+        }
+
+    np_of = {v: nplus(v) for v in adj}
+    tri = {v: 0 for v in adj}
+    for v in adj:
+        for u in np_of[v]:
+            for w in np_of[u]:
+                if w in np_of[v]:
+                    tri[v] += 1
+                    tri[u] += 1
+                    tri[w] += 1
+    out = {}
+    inv = {int(p): o for o, p in enumerate(pid.tolist())}
+    for v, t in tri.items():
+        d = deg[v]
+        out[inv[v]] = 2.0 * t / (d * (d - 1)) if d >= 2 else 0.0
+    return out
+
+
+@pytest.mark.parametrize("app_name", ["lcc_bitmap", "lcc_beta"])
+@pytest.mark.parametrize("thr", [0, 5, 8])
+def test_degree_threshold_parity(app_name, thr):
+    from libgrape_lite_tpu.models import APP_REGISTRY
+
+    n = 48
+    src, dst = er_graph(n)
+    frag = build_fragment(src, dst, None, n, 4)
+    res = collect_worker_result(
+        APP_REGISTRY[app_name](), frag, degree_threshold=thr
+    )
+    want = brute_force_lcc(frag, n, src, dst, thr)
+    assert set(res) == set(want)
+    for k, v in want.items():
+        assert abs(float(res[k]) - v) < 1e-9, (k, res[k], v)
+
+
+def test_threshold_above_max_degree_is_identity():
+    from libgrape_lite_tpu.models import APP_REGISTRY
+
+    n = 48
+    src, dst = er_graph(n)
+    frag = build_fragment(src, dst, None, n, 2)
+    base = collect_worker_result(APP_REGISTRY["lcc"](), frag)
+    same = collect_worker_result(
+        APP_REGISTRY["lcc"](), frag, degree_threshold=n
+    )
+    assert base == same
