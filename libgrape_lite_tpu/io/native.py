@@ -76,8 +76,139 @@ def _load() -> ctypes.CDLL | None:
             lib._gl_has_sort = True
         except AttributeError:
             lib._gl_has_sort = False
+        try:
+            # vertex-map acceleration (id table + MPH), added round 2
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            lib.gl_ht_build.restype = ctypes.c_void_p
+            lib.gl_ht_build.argtypes = [i64p, ctypes.c_int64]
+            lib.gl_ht_insert.restype = None
+            lib.gl_ht_insert.argtypes = [
+                ctypes.c_void_p, i64p, ctypes.c_int64, ctypes.c_void_p,
+            ]
+            lib.gl_ht_lookup.restype = None
+            lib.gl_ht_lookup.argtypes = [
+                ctypes.c_void_p, i64p, ctypes.c_int64, i64p,
+            ]
+            lib.gl_ht_size.restype = ctypes.c_int64
+            lib.gl_ht_size.argtypes = [ctypes.c_void_p]
+            lib.gl_ht_oids.restype = None
+            lib.gl_ht_oids.argtypes = [ctypes.c_void_p, i64p]
+            lib.gl_ht_free.restype = None
+            lib.gl_ht_free.argtypes = [ctypes.c_void_p]
+            lib.gl_mph_build.restype = ctypes.c_void_p
+            lib.gl_mph_build.argtypes = [i64p, ctypes.c_int64]
+            lib.gl_mph_pos.restype = None
+            lib.gl_mph_pos.argtypes = [
+                ctypes.c_void_p, i64p, ctypes.c_int64, i64p,
+            ]
+            lib.gl_mph_bits.restype = ctypes.c_double
+            lib.gl_mph_bits.argtypes = [ctypes.c_void_p]
+            lib.gl_mph_free.restype = None
+            lib.gl_mph_free.argtypes = [ctypes.c_void_p]
+            lib._gl_has_vm = True
+        except AttributeError:
+            lib._gl_has_vm = False
         _lib = lib
         return _lib
+
+
+def _as_i64(a) -> np.ndarray | None:
+    """Contiguous int64 view of an integer array; None for non-integer
+    oid dtypes (string-keyed graphs keep the Python paths)."""
+    arr = np.asarray(a)
+    if not np.issubdtype(arr.dtype, np.integer):
+        return None
+    return np.ascontiguousarray(arr, dtype=np.int64)
+
+
+class NativeIdTable:
+    """Open-addressing oid->lid table (native IdTable; the reference
+    `IdIndexer`, grape/graph/id_indexer.h)."""
+
+    def __init__(self, lib, handle):
+        self._lib = lib
+        self._h = handle
+
+    @classmethod
+    def build(cls, oids: np.ndarray) -> "NativeIdTable | None":
+        lib = _load()
+        if lib is None or not getattr(lib, "_gl_has_vm", False):
+            return None
+        o = _as_i64(oids)
+        if o is None:
+            return None
+        h = lib.gl_ht_build(o, len(o))
+        return cls(lib, h) if h else None
+
+    def insert(self, oids: np.ndarray) -> np.ndarray:
+        """Arrival-order setdefault; returns the lid of each input.
+        Raises TypeError for non-integer oids (callers that allow mixed
+        dtypes must check before inserting)."""
+        o = _as_i64(oids)
+        if o is None:
+            raise TypeError("NativeIdTable.insert: non-integer oids")
+        out = np.empty(len(o), dtype=np.int64)
+        self._lib.gl_ht_insert(self._h, o, len(o), out.ctypes.data)
+        return out
+
+    def lookup(self, oids: np.ndarray) -> np.ndarray:
+        o = _as_i64(oids)
+        if o is None:
+            # a non-integer query can never be in an int64 table
+            return np.full(len(np.asarray(oids)), -1, dtype=np.int64)
+        out = np.empty(len(o), dtype=np.int64)
+        self._lib.gl_ht_lookup(self._h, o, len(o), out)
+        return out
+
+    def size(self) -> int:
+        return int(self._lib.gl_ht_size(self._h))
+
+    def oids(self) -> np.ndarray:
+        out = np.empty(self.size(), dtype=np.int64)
+        self._lib.gl_ht_oids(self._h, out)
+        return out
+
+    def __del__(self):
+        h, self._h = self._h, None
+        if h and self._lib is not None:
+            self._lib.gl_ht_free(h)
+
+
+class NativeMph:
+    """Minimal perfect hash over int64 keys (native PTHash-style build;
+    the reference `pthash_idxer.h` + thirdparty/pthash)."""
+
+    def __init__(self, lib, handle, n):
+        self._lib = lib
+        self._h = handle
+        self._n = n
+
+    @classmethod
+    def build(cls, keys: np.ndarray) -> "NativeMph | None":
+        lib = _load()
+        if lib is None or not getattr(lib, "_gl_has_vm", False):
+            return None
+        k = _as_i64(keys)
+        if k is None or len(k) == 0:
+            return None
+        h = lib.gl_mph_build(k, len(k))
+        return cls(lib, h, len(k)) if h else None
+
+    def positions(self, keys: np.ndarray) -> np.ndarray:
+        """[0, n) position per key; arbitrary for unknown keys (callers
+        verify against their lid->oid array)."""
+        k = _as_i64(keys)
+        out = np.empty(len(k), dtype=np.int64)
+        self._lib.gl_mph_pos(self._h, k, len(k), out)
+        return out
+
+    def bits_per_key(self) -> float:
+        return float(self._lib.gl_mph_bits(self._h))
+
+    def __del__(self):
+        h, self._h = self._h, None
+        if h and self._lib is not None:
+            self._lib.gl_mph_free(h)
 
 
 def available() -> bool:

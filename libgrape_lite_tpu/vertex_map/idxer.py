@@ -7,11 +7,20 @@ local_idxer.h, pthash_idxer.h; dispatch at `idxers.h:26-110`).  Selected by
 All indexers are batch-oriented: `get_index(oids) -> lids` over numpy
 arrays.  The heavy lookup during graph load happens on the host; the
 device side never sees oids (only dense lids/gids).
+
+Integer-keyed graphs route through the native C++ backends
+(native/loader.cc: `gl_ht_*` open-addressing table — the reference
+`IdIndexer`, grape/graph/id_indexer.h — and `gl_mph_*`, a PTHash-style
+minimal perfect hash — the reference pthash_idxer.h + vendored
+thirdparty/pthash).  String-keyed graphs and native-less environments
+fall back to the pure-Python paths below.
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+from libgrape_lite_tpu.io.native import NativeIdTable, NativeMph
 
 
 class IdxerBase:
@@ -29,16 +38,24 @@ class IdxerBase:
 
 
 class HashMapIdxer(IdxerBase):
-    """Dict-backed oid->lid (reference `hashmap_idxer.h`, built on the
-    flat_hash_map `IdIndexer`, `grape/graph/id_indexer.h`)."""
+    """Hash-table oid->lid, lid = insertion order (reference
+    `hashmap_idxer.h` over `IdIndexer`).  Native open-addressing table
+    with threaded batch lookup when oids are integers."""
 
     type_name = "hashmap"
 
     def __init__(self, oids: np.ndarray):
         self._oids = np.asarray(oids)
-        self._o2l = {o: i for i, o in enumerate(self._oids.tolist())}
+        self._native = NativeIdTable.build(self._oids)
+        self._o2l = (
+            None
+            if self._native is not None
+            else {o: i for i, o in enumerate(self._oids.tolist())}
+        )
 
     def get_index(self, oids: np.ndarray) -> np.ndarray:
+        if self._native is not None:
+            return self._native.lookup(oids)
         o2l = self._o2l
         return np.fromiter(
             (o2l.get(o, -1) for o in np.asarray(oids).tolist()),
@@ -54,10 +71,25 @@ class HashMapIdxer(IdxerBase):
 
     def extend(self, new_oids: np.ndarray) -> None:
         """Append vertices (mutation path, reference `vertex_map.h:146-220`)."""
-        start = len(self._oids)
-        self._oids = np.concatenate([self._oids, np.asarray(new_oids)])
-        for i, o in enumerate(np.asarray(new_oids).tolist()):
-            self._o2l.setdefault(o, start + i)
+        arr = np.asarray(new_oids)
+        if self._native is not None:
+            if np.issubdtype(arr.dtype, np.integer):
+                self._native.insert(arr)
+                self._oids = self._native.oids()
+                return
+            # oid dtype widened (e.g. string ids): drain to the dict path
+            self._oids = self._native.oids()
+            self._o2l = {o: i for i, o in enumerate(self._oids.tolist())}
+            self._native = None
+        fresh = []
+        for o in np.asarray(new_oids).tolist():
+            if o not in self._o2l:  # dedups across AND within the batch
+                self._o2l[o] = len(self._oids) + len(fresh)
+                fresh.append(o)
+        if fresh:
+            self._oids = np.concatenate(
+                [self._oids, np.asarray(fresh, dtype=self._oids.dtype)]
+            )
 
 
 class SortedArrayIdxer(IdxerBase):
@@ -90,18 +122,33 @@ class LocalIdxer(IdxerBase):
     type_name = "local"
 
     def __init__(self, oids=None):
+        self._native = None
         self._o2l = {}
-        self._oids = []
+        self._py_oids = []
         if oids is not None:
             self.add(oids)
 
     def add(self, oids: np.ndarray) -> None:
-        for o in np.asarray(oids).tolist():
+        arr = np.asarray(oids)
+        if self._native is None and not self._o2l:
+            self._native = NativeIdTable.build(arr[:0])
+        if self._native is not None and np.issubdtype(arr.dtype, np.integer):
+            self._native.insert(arr)
+            return
+        if self._native is not None:
+            # dtype changed mid-stream (string oids): drain to Python
+            for o in self._native.oids().tolist():
+                self._o2l.setdefault(o, len(self._py_oids))
+                self._py_oids.append(o)
+            self._native = None
+        for o in arr.tolist():
             if o not in self._o2l:
-                self._o2l[o] = len(self._oids)
-                self._oids.append(o)
+                self._o2l[o] = len(self._py_oids)
+                self._py_oids.append(o)
 
     def get_index(self, oids: np.ndarray) -> np.ndarray:
+        if self._native is not None:
+            return self._native.lookup(oids)
         o2l = self._o2l
         return np.fromiter(
             (o2l.get(o, -1) for o in np.asarray(oids).tolist()),
@@ -110,41 +157,63 @@ class LocalIdxer(IdxerBase):
         )
 
     def get_oid(self, lids: np.ndarray) -> np.ndarray:
-        arr = np.asarray(self._oids)
+        arr = (
+            self._native.oids()
+            if self._native is not None
+            else np.asarray(self._py_oids)
+        )
         return arr[np.asarray(lids)]
 
     def size(self) -> int:
-        return len(self._oids)
+        if self._native is not None:
+            return self._native.size()
+        return len(self._py_oids)
 
 
 class PerfectHashIdxer(IdxerBase):
-    """Minimal-perfect-hash idxer (reference `pthash_idxer.h` backed by the
-    vendored PTHash).  We get the same O(1)/low-memory behaviour with a
-    two-level displacement table built on the host; for now we delegate to
-    SortedArrayIdxer lookup semantics with a dense displacement cache,
-    which keeps the same API and determinism (lid = insertion order).
-    """
+    """Minimal-perfect-hash idxer (reference `pthash_idxer.h` backed by
+    the vendored PTHash).  lid = MPH position (like the reference, lid
+    assignment is idxer-specific); membership of a query oid is verified
+    against the lid->oid array, which GetOid needs anyway.  Falls back
+    to sorted-array semantics when the native library is unavailable or
+    oids are strings."""
 
     type_name = "pthash"
 
     def __init__(self, oids: np.ndarray):
-        self._oids = np.asarray(oids)
-        order = np.argsort(self._oids, kind="stable")
-        self._sorted = self._oids[order]
+        oids = np.asarray(oids)
+        self._mph = NativeMph.build(oids)
+        if self._mph is not None:
+            pos = self._mph.positions(oids)
+            table = np.empty(len(oids), dtype=np.int64)
+            table[pos] = oids
+            self._oid_by_lid = table
+            self._sorted = None
+            return
+        # fallback: binary-search emulation (same API, not an MPH)
+        self._oid_by_lid = oids
+        order = np.argsort(oids, kind="stable")
+        self._sorted = oids[order]
         self._rank_to_lid = order.astype(np.int64)
 
     def get_index(self, oids: np.ndarray) -> np.ndarray:
         q = np.asarray(oids)
+        if self._mph is not None:
+            if len(q) == 0 or not np.issubdtype(q.dtype, np.integer):
+                return np.full(len(q), -1, dtype=np.int64)
+            pos = self._mph.positions(q)
+            ok = self._oid_by_lid[pos] == q
+            return np.where(ok, pos, -1).astype(np.int64)
         pos = np.searchsorted(self._sorted, q)
         pos_c = np.clip(pos, 0, len(self._sorted) - 1)
         ok = self._sorted[pos_c] == q
         return np.where(ok, self._rank_to_lid[pos_c], -1).astype(np.int64)
 
     def get_oid(self, lids: np.ndarray) -> np.ndarray:
-        return self._oids[np.asarray(lids)]
+        return self._oid_by_lid[np.asarray(lids)]
 
     def size(self) -> int:
-        return len(self._oids)
+        return len(self._oid_by_lid)
 
 
 def make_idxer(kind: str, oids: np.ndarray) -> IdxerBase:

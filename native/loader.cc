@@ -12,11 +12,13 @@
 //
 // Build: `make -C native` produces libgrape_tpu_native.so.
 
+#include <algorithm>
 #include <cctype>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <fcntl.h>
+#include <new>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <thread>
@@ -158,9 +160,275 @@ Parsed* parse_file(const char* path, int ncols, int weighted, int nthreads) {
   return out;
 }
 
+inline uint64_t mix64(uint64_t x) {
+  // splitmix64 finalizer — the hash behind both the id table and the MPH
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+// ---------------------------------------------------------------------------
+// Open-addressing oid->lid table — the reference `IdIndexer`
+// (grape/graph/id_indexer.h, ska::flat_hash_map-style) rebuilt as a
+// linear-probing int64 table with batch, multithreaded lookup.  Replaces
+// the Python dict loops that made the host vertex map the load-path
+// bottleneck at LDBC scale.
+// ---------------------------------------------------------------------------
+
+struct IdTable {
+  std::vector<int64_t> slot_key;
+  std::vector<int64_t> slot_val;  // -1 = empty
+  std::vector<int64_t> oids;      // lid -> oid (insertion order)
+  uint64_t mask = 0;
+
+  void rebuild(size_t need) {
+    size_t cap = 16;
+    while (cap < need * 2) cap <<= 1;  // load factor <= 0.5
+    slot_key.assign(cap, 0);
+    slot_val.assign(cap, -1);
+    mask = cap - 1;
+    for (size_t i = 0; i < oids.size(); ++i) place(oids[i], (int64_t)i);
+  }
+
+  void place(int64_t key, int64_t val) {
+    uint64_t s = mix64((uint64_t)key) & mask;
+    while (slot_val[s] != -1) s = (s + 1) & mask;
+    slot_key[s] = key;
+    slot_val[s] = val;
+  }
+
+  // arrival-order setdefault: returns the existing or new lid
+  int64_t insert(int64_t key) {
+    uint64_t s = mix64((uint64_t)key) & mask;
+    while (slot_val[s] != -1) {
+      if (slot_key[s] == key) return slot_val[s];
+      s = (s + 1) & mask;
+    }
+    int64_t lid = (int64_t)oids.size();
+    slot_key[s] = key;
+    slot_val[s] = lid;
+    oids.push_back(key);
+    if (oids.size() * 2 > slot_key.size()) rebuild(oids.size());
+    return lid;
+  }
+
+  int64_t find(int64_t key) const {
+    uint64_t s = mix64((uint64_t)key) & mask;
+    while (slot_val[s] != -1) {
+      if (slot_key[s] == key) return slot_val[s];
+      s = (s + 1) & mask;
+    }
+    return -1;
+  }
+};
+
+void table_lookup_range(const IdTable* t, const int64_t* q, int64_t lo,
+                        int64_t hi, int64_t* out) {
+  for (int64_t i = lo; i < hi; ++i) out[i] = t->find(q[i]);
+}
+
+// ---------------------------------------------------------------------------
+// PTHash-style minimal perfect hash (reference `pthash_idxer.h` +
+// vendored thirdparty/pthash): keys -> [0, n) bijectively.  Buckets of
+// ~3 keys, per-bucket pilot search with xor displacement into a table
+// of size n/alpha, then the standard free-slot remap down to [0, n).
+// Build is load-path-only; lookups are branch-light and batch-threaded.
+// Unknown keys return an arbitrary in-range position — callers verify
+// against the lid->oid array (which they keep for GetOid anyway).
+// ---------------------------------------------------------------------------
+
+struct Mph {
+  uint64_t seed = 0;
+  uint64_t n = 0;    // number of keys == output range
+  uint64_t tsz = 0;  // intermediate range (n / alpha)
+  uint64_t m = 0;    // bucket count
+  std::vector<uint32_t> pilots;
+  std::vector<int64_t> remap;  // [tsz - n] -> free slots below n
+
+  inline uint64_t pos_of(int64_t key) const {
+    uint64_t h = mix64((uint64_t)key ^ seed);
+    uint64_t b = h % m;
+    uint64_t pos = mix64(h ^ mix64((uint64_t)pilots[b] + 0x51ab2cd3ull)) % tsz;
+    if (pos >= n) pos = (uint64_t)remap[pos - n];
+    return pos;
+  }
+};
+
+constexpr uint32_t kPilotLimit = 1u << 18;
+
+bool mph_try_build(Mph* M, const int64_t* keys, int64_t n, uint64_t seed) {
+  M->seed = seed;
+  M->n = (uint64_t)n;
+  M->tsz = (uint64_t)(n / 0.97) + 1;
+  M->m = (uint64_t)(n / 3) + 1;
+  M->pilots.assign(M->m, 0);
+  M->remap.assign(M->tsz - M->n, 0);
+
+  // counting-sort keys' hashes into buckets
+  std::vector<uint64_t> h(n);
+  std::vector<uint32_t> bcnt(M->m + 1, 0);
+  for (int64_t i = 0; i < n; ++i) {
+    h[i] = mix64((uint64_t)keys[i] ^ seed);
+    ++bcnt[h[i] % M->m];
+  }
+  std::vector<uint32_t> bstart(M->m + 1, 0);
+  for (uint64_t b = 0; b < M->m; ++b) bstart[b + 1] = bstart[b] + bcnt[b];
+  std::vector<uint64_t> bh(n);
+  {
+    std::vector<uint32_t> cur(bstart.begin(), bstart.end() - 1);
+    for (int64_t i = 0; i < n; ++i) bh[cur[h[i] % M->m]++] = h[i];
+  }
+  // buckets ordered by size descending (PTHash's search order)
+  std::vector<uint32_t> order(M->m);
+  for (uint64_t b = 0; b < M->m; ++b) order[b] = (uint32_t)b;
+  std::vector<uint32_t> sizes(M->m);
+  for (uint64_t b = 0; b < M->m; ++b) sizes[b] = bcnt[b];
+  std::sort(order.begin(), order.end(),
+            [&](uint32_t a, uint32_t b) { return sizes[a] > sizes[b]; });
+
+  std::vector<uint8_t> taken(M->tsz, 0);
+  std::vector<uint64_t> tpos(64);
+  for (uint32_t b : order) {
+    uint32_t sz = sizes[b];
+    if (sz == 0) continue;
+    if (sz > 64) return false;  // absurd skew: retry with a new seed
+    const uint64_t* hk = &bh[bstart[b]];
+    // duplicate keys in one bucket can never be separated
+    for (uint32_t i = 0; i < sz; ++i)
+      for (uint32_t j = i + 1; j < sz; ++j)
+        if (hk[i] == hk[j]) return false;
+    uint32_t p = 0;
+    for (; p < kPilotLimit; ++p) {
+      uint64_t ph = mix64((uint64_t)p + 0x51ab2cd3ull);
+      bool ok = true;
+      for (uint32_t i = 0; i < sz && ok; ++i) {
+        uint64_t pos = mix64(hk[i] ^ ph) % M->tsz;
+        if (taken[pos]) ok = false;
+        for (uint32_t j = 0; j < i && ok; ++j)
+          if (tpos[j] == pos) ok = false;
+        tpos[i] = pos;
+      }
+      if (ok) break;
+    }
+    if (p == kPilotLimit) return false;
+    M->pilots[b] = p;
+    for (uint32_t i = 0; i < sz; ++i) taken[tpos[i]] = 1;
+  }
+  // minimal remap: taken slots >= n -> free slots < n, in order
+  uint64_t free_slot = 0;
+  for (uint64_t pos = M->n; pos < M->tsz; ++pos) {
+    if (taken[pos]) {
+      while (free_slot < M->n && taken[free_slot]) ++free_slot;
+      M->remap[pos - M->n] = (int64_t)free_slot++;
+    }
+  }
+  return true;
+}
+
+void mph_pos_range(const Mph* M, const int64_t* q, int64_t lo, int64_t hi,
+                   int64_t* out) {
+  for (int64_t i = lo; i < hi; ++i) out[i] = (int64_t)M->pos_of(q[i]);
+}
+
+int nthreads_for(int64_t n) {
+  if (n < (1 << 16)) return 1;
+  int t = (int)std::thread::hardware_concurrency();
+  return t < 1 ? 1 : t;
+}
+
 }  // namespace
 
 extern "C" {
+
+// ---- id table (oid -> lid) ----
+
+void* gl_ht_build(const int64_t* keys, int64_t n) {
+  auto* t = new (std::nothrow) IdTable();
+  if (!t) return nullptr;
+  t->oids.reserve((size_t)n);
+  t->rebuild((size_t)n + 1);
+  for (int64_t i = 0; i < n; ++i) t->insert(keys[i]);
+  return t;
+}
+
+void gl_ht_insert(void* handle, const int64_t* keys, int64_t n,
+                  int64_t* out_lids) {
+  auto* t = static_cast<IdTable*>(handle);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t lid = t->insert(keys[i]);
+    if (out_lids) out_lids[i] = lid;
+  }
+}
+
+void gl_ht_lookup(void* handle, const int64_t* q, int64_t n, int64_t* out) {
+  auto* t = static_cast<IdTable*>(handle);
+  int nt = nthreads_for(n);
+  if (nt == 1) {
+    table_lookup_range(t, q, 0, n, out);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t per = (n + nt - 1) / nt;
+  for (int tix = 0; tix < nt; ++tix) {
+    int64_t lo = tix * per, hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    threads.emplace_back(table_lookup_range, t, q, lo, hi, out);
+  }
+  for (auto& th : threads) th.join();
+}
+
+int64_t gl_ht_size(void* handle) {
+  return (int64_t)static_cast<IdTable*>(handle)->oids.size();
+}
+
+void gl_ht_oids(void* handle, int64_t* out) {
+  auto* t = static_cast<IdTable*>(handle);
+  std::memcpy(out, t->oids.data(), t->oids.size() * sizeof(int64_t));
+}
+
+void gl_ht_free(void* handle) { delete static_cast<IdTable*>(handle); }
+
+// ---- minimal perfect hash (pthash idxer backend) ----
+
+void* gl_mph_build(const int64_t* keys, int64_t n) {
+  if (n <= 0) return nullptr;
+  auto* M = new (std::nothrow) Mph();
+  if (!M) return nullptr;
+  for (uint64_t attempt = 0; attempt < 8; ++attempt) {
+    if (mph_try_build(M, keys, n, mix64(0xdecafbadull + attempt)))
+      return M;
+  }
+  delete M;  // duplicate keys or pathological input
+  return nullptr;
+}
+
+void gl_mph_pos(void* handle, const int64_t* q, int64_t n, int64_t* out) {
+  auto* M = static_cast<Mph*>(handle);
+  int nt = nthreads_for(n);
+  if (nt == 1) {
+    mph_pos_range(M, q, 0, n, out);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t per = (n + nt - 1) / nt;
+  for (int tix = 0; tix < nt; ++tix) {
+    int64_t lo = tix * per, hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    threads.emplace_back(mph_pos_range, M, q, lo, hi, out);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// bits per key of the MPH structure (diagnostic)
+double gl_mph_bits(void* handle) {
+  auto* M = static_cast<Mph*>(handle);
+  double bits = 8.0 * (M->pilots.size() * sizeof(uint32_t) +
+                       M->remap.size() * sizeof(int64_t));
+  return bits / (double)M->n;
+}
+
+void gl_mph_free(void* handle) { delete static_cast<Mph*>(handle); }
 
 // Stable two-pass counting sort of an edge list by (src, nbr) — the
 // CSR build's lexsort (graph/csr.py), O(E + V) instead of comparison

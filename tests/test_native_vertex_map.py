@@ -1,0 +1,94 @@
+"""Native vertex-map backends: the open-addressing id table
+(`native/loader.cc:gl_ht_*`, reference grape/graph/id_indexer.h) and the
+PTHash-style minimal perfect hash (`gl_mph_*`, reference
+pthash_idxer.h).  Skipped when the native .so is unavailable."""
+
+import numpy as np
+import pytest
+
+from libgrape_lite_tpu.io import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available() or not getattr(native._load(), "_gl_has_vm", False),
+    reason="native vertex-map backend unavailable",
+)
+
+
+def unique_keys(n, seed=0):
+    rng = np.random.default_rng(seed)
+    # spread across the full int64 range, including negatives
+    keys = rng.integers(-(2**62), 2**62, size=2 * n, dtype=np.int64)
+    return np.unique(keys)[:n]
+
+
+def test_id_table_roundtrip():
+    keys = unique_keys(200_000)
+    t = native.NativeIdTable.build(keys)
+    assert t.size() == len(keys)
+    np.testing.assert_array_equal(t.lookup(keys), np.arange(len(keys)))
+    np.testing.assert_array_equal(t.oids(), keys)
+    missing = keys + 1  # may collide with other keys occasionally
+    got = t.lookup(missing)
+    for q, lid in zip(missing[:100].tolist(), got[:100].tolist()):
+        idx = np.searchsorted(keys, q)
+        present = idx < len(keys) and keys[idx] == q
+        assert (lid >= 0) == present
+
+
+def test_id_table_insert_arrival_order():
+    t = native.NativeIdTable.build(np.array([7, 3], dtype=np.int64))
+    lids = t.insert(np.array([3, 9, 7, 9], dtype=np.int64))
+    np.testing.assert_array_equal(lids, [1, 2, 0, 2])
+    np.testing.assert_array_equal(t.oids(), [7, 3, 9])
+
+
+def test_mph_is_minimal_and_perfect():
+    keys = unique_keys(150_000, seed=1)
+    m = native.NativeMph.build(keys)
+    assert m is not None
+    pos = m.positions(keys)
+    assert pos.min() == 0 and pos.max() == len(keys) - 1
+    assert len(np.unique(pos)) == len(keys)  # bijection onto [0, n)
+    assert m.bits_per_key() < 16  # compact: far below a hash table
+
+
+def test_mph_build_rejects_duplicates():
+    keys = np.array([5, 5, 7], dtype=np.int64)
+    assert native.NativeMph.build(keys) is None
+
+
+def test_pthash_idxer_end_to_end():
+    from libgrape_lite_tpu.vertex_map.idxer import PerfectHashIdxer
+
+    keys = unique_keys(50_000, seed=2)
+    ix = PerfectHashIdxer(keys)
+    assert ix._mph is not None  # the real MPH, not the fallback
+    lids = ix.get_index(keys)
+    assert len(np.unique(lids)) == len(keys)
+    np.testing.assert_array_equal(ix.get_oid(lids), keys)
+    np.testing.assert_array_equal(
+        ix.get_index(np.array([keys.max() + 3], dtype=np.int64)), [-1]
+    )
+
+
+def test_hashmap_idxer_native_path_matches_dict(monkeypatch):
+    from libgrape_lite_tpu.vertex_map import idxer as ix_mod
+
+    keys = unique_keys(30_000, seed=3)
+    fast = ix_mod.HashMapIdxer(keys)
+    assert fast._native is not None
+    monkeypatch.setattr(
+        ix_mod.NativeIdTable, "build", classmethod(lambda cls, o: None)
+    )
+    slow = ix_mod.HashMapIdxer(keys)
+    assert slow._native is None
+    q = np.concatenate([keys[::7], keys[:5] + 1])
+    np.testing.assert_array_equal(fast.get_index(q), slow.get_index(q))
+    ext = np.array([keys.max() + 10, keys[0]], dtype=np.int64)
+    fast.extend(ext)
+    slow.extend(ext)
+    np.testing.assert_array_equal(
+        fast.get_index(ext), slow.get_index(ext)
+    )
+    assert fast.size() == slow.size() == len(keys) + 1
