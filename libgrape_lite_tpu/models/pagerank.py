@@ -54,12 +54,22 @@ class PageRank(BatchShuffleAppBase):
         )
         self.dtype = np.dtype(dtype) if np.dtype(dtype).kind == "f" else np.dtype(np.float64)
         rank = np.zeros((frag.fnum, frag.vp), dtype=self.dtype)
-        return {
+        state = {
             "rank": rank,
             "step": np.int32(0),
             "dangling_sum": self.dtype.type(0),
             "total_dangling": self.dtype.type(0),
         }
+        # strict-tile SpMV plan (ops/spmv.py plan_for_app; the LBSTRICT
+        # analogue): adopted per-shape on TPU/f32, forced via GRAPE_SPMV
+        from libgrape_lite_tpu.ops.spmv import plan_for_app
+
+        plan = plan_for_app(frag, frag.vp, self.dtype)
+        self._spmv_tile = plan[1] if plan else 0
+        self._spmv_rmax = plan[2] if plan else 0
+        if plan:
+            state["spmv_row_lo"] = plan[0]
+        return state
 
     def peval(self, ctx: StepContext, frag, state):
         n = frag.total_vnum
@@ -74,6 +84,7 @@ class PageRank(BatchShuffleAppBase):
         )
         total_dangling = ctx.sum(dangling.sum().astype(dt))
         state = dict(
+            state,  # preserve pass-through keys (e.g. spmv_row_lo)
             rank=rank,
             step=jnp.int32(0),
             dangling_sum=p * total_dangling,
@@ -106,10 +117,10 @@ class PageRank(BatchShuffleAppBase):
         finald = jnp.where(deg > 0, nxt * deg.astype(dt), nxt)
         rank_out = jnp.where(is_last, finald, nxt)
         new_state = dict(
+            state,  # preserve pass-through keys (e.g. spmv_row_lo)
             rank=rank_out,
             step=step,
             dangling_sum=dangling_sum,
-            total_dangling=state["total_dangling"],
         )
         return new_state, jnp.where(is_last, jnp.int32(0), jnp.int32(1))
 
@@ -123,7 +134,14 @@ class PageRank(BatchShuffleAppBase):
         ie = frag.ie
         full = ctx.gather_state(rank)
         contrib = jnp.where(ie.edge_mask, full[ie.edge_nbr], jnp.asarray(0, dt))
-        cur = self.segment_reduce(contrib, ie.edge_src, frag.vp, "sum")
+        from libgrape_lite_tpu.ops.spmv import segment_sum_auto
+
+        plan = (
+            (state["spmv_row_lo"], self._spmv_tile, self._spmv_rmax)
+            if "spmv_row_lo" in state
+            else None
+        )
+        cur = segment_sum_auto(contrib, ie.edge_src, frag.vp, plan).astype(dt)
         return self.round_update(frag, state, cur)
 
     def finalize(self, frag, state):

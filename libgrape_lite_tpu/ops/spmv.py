@@ -21,10 +21,12 @@ replaces the scatter with MXU work:
 The tradeoff is explicit: MXU MACs per tile = tile × rmax.  On
 hub-dominated tiles (power-law graphs) rmax is tiny and the kernel is
 pure wins; on degree-1 tails rmax → tile and the indicator matmul
-wastes FLOPs.  `segment_sum_auto` picks per-shape: the kernel when the
-planned rmax is small relative to the tile (dense rows), the XLA path
-otherwise — the same adaptivity the reference gets from choosing
-cm/wm/strict per app.
+wastes FLOPs.  `segment_sum_auto` + `plan_for_app` pick per-shape: the
+kernel when the planned rmax is small relative to the tile (dense
+rows, `strict_worthwhile`), the XLA path otherwise — the same
+adaptivity the reference gets from choosing cm/wm/strict per app.
+PageRank's pull consumes this (models/pagerank.py); `GRAPE_SPMV`
+(auto|strict|xla) overrides the choice for A/B runs.
 
 A/B-measure with `scripts/spmv_ab.py` on real TPU before changing any
 default (VERDICT r1 next-round item 2).
@@ -117,23 +119,25 @@ def _spmv_partials(values, edge_src, row_lo, tile, rmax, num_tiles, vp,
     )
 
 
-def spmv_strict(values, edge_src, row_lo_np, vp: int, tile: int, rmax: int,
+def spmv_strict(values, edge_src, row_lo, vp: int, tile: int, rmax: int,
                 interpret: bool | None = None):
     """Strict-tile segment-sum of `values` by sorted `edge_src` into
     [vp] rows (drop-in for ops.segment.segment_reduce(..., "sum") on
-    sorted float inputs).  `interpret=None` auto-selects: compiled on
-    TPU, interpreter elsewhere (CPU backends can't lower Pallas)."""
+    sorted float inputs).  `row_lo` may be host numpy or a traced
+    per-shard array (shard_map callers pass their slice).
+    `interpret=None` auto-selects: compiled on TPU, interpreter
+    elsewhere (CPU backends can't lower Pallas)."""
     if interpret is None:
         from libgrape_lite_tpu.ops.pallas_kernels import use_pallas
 
         interpret = not use_pallas()
-    num_tiles = len(row_lo_np)
+    num_tiles = row_lo.shape[0]
     partials = _spmv_partials(
-        values, edge_src, jnp.asarray(row_lo_np), tile, rmax, num_tiles, vp,
+        values, edge_src, jnp.asarray(row_lo), tile, rmax, num_tiles, vp,
         interpret=interpret,
     )
     # fold tile partials: rows of tile t live at row_lo[t] + [0, rmax)
-    idx = jnp.asarray(row_lo_np, jnp.int32)[:, None] + jnp.arange(
+    idx = jnp.asarray(row_lo, jnp.int32)[:, None] + jnp.arange(
         rmax, dtype=jnp.int32
     )
     idx = jnp.minimum(idx, vp)  # clamp into the overflow row
@@ -147,3 +151,71 @@ def strict_worthwhile(rmax: int, tile: int) -> bool:
     for tile useful adds — accept up to 8 lanes of row window per
     128-edge MXU pass (hub-heavy tiles), reject degree-1 tails."""
     return rmax * 16 <= tile
+
+
+_PLAN_CACHE: "weakref.WeakKeyDictionary" = None  # set on first use
+
+
+def plan_for_app(frag, vp: int, dtype, tile: int = 2048,
+                 mode: str | None = None):
+    """Host-side SpMV planning for a fragment's in-edge array: returns
+    (row_lo [fnum, num_tiles] int32, tile, rmax) when the strict kernel
+    should serve this app's segment-sums, else None (XLA `segment_sum`).
+
+    Selection (`GRAPE_SPMV` env: auto|strict|xla, default auto):
+      * `xla` — never;
+      * `strict` — always (A/B runs; interpret-mode off-TPU);
+      * `auto` — only on a real TPU backend, float32 values (the MXU
+        path accumulates in f32; f64 states keep XLA), and
+        `strict_worthwhile` on the worst tile span.
+
+    The cheap mode/backend/dtype rejections run BEFORE the O(E)
+    device-to-host copy + tile scan, and accepted plans are cached per
+    fragment — queries repeat, topology does not.
+    """
+    import os
+    import weakref
+
+    mode = mode or os.environ.get("GRAPE_SPMV", "auto")
+    if mode == "xla":
+        return None
+    if mode != "strict":
+        from libgrape_lite_tpu.ops.pallas_kernels import use_pallas
+
+        if not use_pallas():
+            return None
+        if np.dtype(dtype) != np.float32:
+            return None
+
+    global _PLAN_CACHE
+    if _PLAN_CACHE is None:
+        _PLAN_CACHE = weakref.WeakKeyDictionary()
+    key = (tile, vp)
+    cached = _PLAN_CACHE.get(frag, {}).get(key)
+    if cached is None:
+        edge_src_stacked = np.asarray(frag.dev.ie.edge_src)
+        fnum = edge_src_stacked.shape[0]
+        plans = [
+            plan_tiles(edge_src_stacked[f], tile, vp) for f in range(fnum)
+        ]
+        rmax = max(p[1] for p in plans)
+        row_lo = np.stack([p[0] for p in plans]).astype(np.int32)
+        cached = (row_lo, tile, rmax)
+        _PLAN_CACHE.setdefault(frag, {})[key] = cached
+    row_lo, tile, rmax = cached
+    if mode != "strict" and not strict_worthwhile(rmax, tile):
+        return None
+    return row_lo, tile, rmax
+
+
+def segment_sum_auto(values, edge_src, vp: int, plan=None):
+    """Sorted segment-sum routed per the host plan: the strict-tile
+    Pallas kernel when `plan` is a (row_lo_local, tile, rmax) triple
+    (row_lo_local = this shard's [num_tiles] slice), the XLA
+    gather+segment_sum otherwise."""
+    if plan is None:
+        from libgrape_lite_tpu.ops.segment import segment_reduce
+
+        return segment_reduce(values, edge_src, vp, "sum")
+    row_lo, tile, rmax = plan
+    return spmv_strict(values, edge_src, row_lo, vp, tile, rmax)
