@@ -97,6 +97,18 @@ class AppBase:
     # with leading fragment dim)
     replicated_keys: FrozenSet[str] = frozenset()
 
+    # which mesh the superstep runs on: "frag" = the 1-D fragment axis
+    # (default); "vc2d" = the k x k (vcrow, vccol) SUMMA mesh for
+    # vertex-cut apps (CommSpec.mesh2d)
+    mesh_kind: str = "frag"
+
+    def custom_specs(self) -> Dict:
+        """Per-key PartitionSpec overrides for state leaves that are
+        neither [fnum, ...]-sharded nor replicated (e.g. SUMMA row/col
+        chunk state, P("vcrow") / P("vccol")).  These leaves pass into
+        the traced step as their per-shard blocks, unsqueezed."""
+        return {}
+
     # 0 means "run until the termination vote fires"
     max_rounds: int = 0
 

@@ -27,7 +27,10 @@ from libgrape_lite_tpu.models.kcore import KCore
 from libgrape_lite_tpu.models.core_decomposition import CoreDecomposition
 from libgrape_lite_tpu.models.pagerank_local import PageRankLocal
 from libgrape_lite_tpu.models.kclique import KClique
-from libgrape_lite_tpu.models.pagerank_vc import PageRankVC
+from libgrape_lite_tpu.models.pagerank_vc import (
+    PageRankVC,
+    PageRankVCReplicated,
+)
 from libgrape_lite_tpu.models.lcc_directed import LCCDirected
 from libgrape_lite_tpu.models.wcc_opt import WCCOpt
 from libgrape_lite_tpu.models.sssp_msg import BFSMsg, SSSPMsg
@@ -67,9 +70,16 @@ APP_REGISTRY = {
     "cdlp_opt": CDLP,
     "cdlp_opt_ud": CDLP,
     "cdlp_opt_ud_dense": CDLP,
-    "lcc": LCC,
-    "lcc_auto": LCC,
+    # `lcc` = the merge-intersection variant (LCCBeta): measured 6.1s
+    # warm vs 10.8s for the bitmap kernel on the p2p-31 CI config
+    # (4-dev CPU mesh, scripts/run_ldbc.py, round 2); O(chunk·Dmax)
+    # working set scales past the bitmap's O(N/32)-per-row.  The bitmap
+    # variant stays as lcc_opt/lcc_bitmap (its VPU popcount path is the
+    # analogue of the reference's SIMD lcc_opt.h) pending a TPU A/B.
+    "lcc": LCCBeta,
+    "lcc_auto": LCCBeta,
     "lcc_opt": LCC,
+    "lcc_bitmap": LCC,
     "lcc_beta": LCCBeta,
     "lcc_directed": LCCDirected,
     # pagerank already pulls over in-edges (pagerank_parallel.h
@@ -81,5 +91,8 @@ APP_REGISTRY = {
     "core_decomposition": CoreDecomposition,
     "pagerank_local": PageRankLocal,
     "pagerank_local_parallel": PageRankLocal,
+    # pagerank_vc = SUMMA-sharded master state (O(N/k) per device);
+    # _rep keeps the mesh-replicated round-1 formulation for A/B
     "pagerank_vc": PageRankVC,
+    "pagerank_vc_rep": PageRankVCReplicated,
 }

@@ -9,13 +9,27 @@ Re-design of `examples/analytical_apps/pagerank/pagerank_vc.h` +
     `pagerank_vc.h` IncEval),
   * per-round: every fragment scatter-adds `curr[src] -> next[dst]` and
     `curr[dst] -> next[src]` over its edge block, partial sums are
-    gathered to masters (`GatherMasterVertices` with NumericSum) — on
-    TPU one `psum` over the frag axis,
+    gathered to masters (`GatherMasterVertices` with NumericSum),
   * master update `(base + d·sum)/deg` (final round: `d·sum + base`),
-    then ScatterMasterVertices — free here because master state is
-    mesh-replicated.
+    then ScatterMasterVertices.
 
-State lives in the padded 1-D gpid space of the vertex-cut chunks.
+TPU formulation (SUMMA): the k x k fragment grid IS a 2-D device mesh
+(`CommSpec.mesh2d`, axes vcrow/vccol; fragment (i, j) holds the edge
+block src∈chunk_i x dst∈chunk_j).  Master state is SHARDED, not
+replicated: device (i, j) keeps rank/deg for chunk i (row copy) and
+chunk j (column copy) — O(N/k) per device, realizing the 2-D
+partition's memory advantage
+(`immutable_vertexcut_fragment.h:82-148`).  Per round:
+
+  * scatter into dst: partials psum over `vcrow` → complete chunk-j
+    sums, column-sharded (the GatherToMaster segment-reduce);
+  * scatter into src: partials psum over `vccol` → row-sharded, then
+    ONE transpose `ppermute` ((i,j)→(j,i)) aligns them column-sharded;
+  * the master update runs on the column copy; a second transpose
+    refreshes the row copy (ScatterToFragment).
+
+PageRankVCReplicated keeps the round-1 mesh-replicated formulation for
+A/B (`pagerank_vc_rep`).
 """
 
 from __future__ import annotations
@@ -23,12 +37,167 @@ from __future__ import annotations
 import jax.numpy as jnp
 import jax.ops as jops
 import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from libgrape_lite_tpu.app.base import GatherScatterAppBase, StepContext
+from libgrape_lite_tpu.parallel.comm_spec import VC_COL_AXIS, VC_ROW_AXIS
 from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
 
 
+def _transpose(x, k):
+    """Swap row/col sharding of a chunk-sharded per-device block: device
+    (i, j) exchanges with (j, i) — one ppermute over the joint axis."""
+    if k == 1:
+        return x
+    perm = [(i * k + j, j * k + i) for i in range(k) for j in range(k)]
+    return lax.ppermute(x, (VC_ROW_AXIS, VC_COL_AXIS), perm)
+
+
 class PageRankVC(GatherScatterAppBase):
+    load_strategy = LoadStrategy.kNullLoadStrategy
+    message_strategy = MessageStrategy.kGatherScatter
+    result_format = "float"
+    mesh_kind = "vc2d"
+    replicated_keys = frozenset({"step", "dangling_sum", "total_dangling"})
+
+    def __init__(self, delta: float = 0.85, max_round: int = 10):
+        self.delta = delta
+        self.max_round = max_round
+
+    def custom_specs(self):
+        return {
+            "rank_col": P(VC_COL_AXIS), "rank_row": P(VC_ROW_AXIS),
+            "deg_col": P(VC_COL_AXIS), "deg_row": P(VC_ROW_AXIS),
+            "vmask_col": P(VC_COL_AXIS), "vmask_row": P(VC_ROW_AXIS),
+        }
+
+    def init_state(self, frag, delta: float | None = None,
+                   max_round: int | None = None):
+        if delta is not None:
+            self.delta = delta
+        if max_round is not None:
+            self.max_round = max_round
+        n_pad = frag.dev.n_pad
+        vmask = frag.vertex_mask()
+        return {
+            # global [k*vc] leaves; placement shards them into [vc]
+            # row/col chunk copies per device
+            "rank_col": np.zeros(n_pad, dtype=np.float64),
+            "rank_row": np.zeros(n_pad, dtype=np.float64),
+            "deg_col": np.zeros(n_pad, dtype=np.int32),
+            "deg_row": np.zeros(n_pad, dtype=np.int32),
+            "vmask_col": vmask,
+            "vmask_row": vmask,
+            "step": np.int32(0),
+            "dangling_sum": np.float64(0),
+            "total_dangling": np.float64(0),
+        }
+
+    def peval(self, ctx: StepContext, frag, state):
+        k, vc = frag.k, frag.vc
+        dt = state["rank_col"].dtype
+        vmask_col = state["vmask_col"]
+
+        ones = jnp.where(frag.mask, 1, 0)
+        # degree: appearances as dst (column copy) + as src (row copy)
+        dd = lax.psum(
+            jops.segment_sum(ones, frag.dst % vc, num_segments=vc),
+            VC_ROW_AXIS,
+        )
+        ds = lax.psum(
+            jops.segment_sum(ones, frag.src % vc, num_segments=vc),
+            VC_COL_AXIS,
+        )
+        deg_col = (dd + _transpose(ds, k)).astype(jnp.int32)
+        deg_row = _transpose(deg_col, k)
+
+        # global vertex count: each column chunk counted once per row
+        n = lax.psum(vmask_col.sum(), VC_COL_AXIS).astype(dt)
+        p = jnp.asarray(1.0, dt) / n
+        dangling = jnp.logical_and(vmask_col, deg_col == 0)
+        total_dangling = lax.psum(dangling.sum(), VC_COL_AXIS).astype(dt)
+
+        rank_col = jnp.where(
+            vmask_col,
+            jnp.where(deg_col > 0, p / jnp.maximum(deg_col, 1).astype(dt), p),
+            jnp.asarray(0, dt),
+        )
+        state = dict(
+            state,
+            rank_col=rank_col,
+            rank_row=_transpose(rank_col, k),
+            deg_col=deg_col,
+            deg_row=deg_row,
+            dangling_sum=p * total_dangling,
+            total_dangling=total_dangling,
+            step=jnp.int32(0),
+        )
+        return state, jnp.int32(1 if self.max_round > 0 else 0)
+
+    def inceval(self, ctx: StepContext, frag, state):
+        k, vc = frag.k, frag.vc
+        dt = state["rank_col"].dtype
+        vmask_col = state["vmask_col"]
+        deg_col = state["deg_col"]
+        n = lax.psum(vmask_col.sum(), VC_COL_AXIS).astype(dt)
+        d = self.delta
+
+        step = state["step"] + 1
+        base = jnp.asarray(1.0 - d, dt) / n + jnp.asarray(d, dt) * state["dangling_sum"] / n
+        dangling_sum = base * state["total_dangling"]
+
+        zero = jnp.asarray(0, dt)
+        # src-side ranks flow to dst (column direction) and vice versa
+        c_src = jnp.where(frag.mask, state["rank_row"][frag.src % vc], zero)
+        c_dst = jnp.where(frag.mask, state["rank_col"][frag.dst % vc], zero)
+        into_dst = lax.psum(
+            jops.segment_sum(c_src, frag.dst % vc, num_segments=vc),
+            VC_ROW_AXIS,
+        )
+        into_src = lax.psum(
+            jops.segment_sum(c_dst, frag.src % vc, num_segments=vc),
+            VC_COL_AXIS,
+        )
+        gathered = into_dst + _transpose(into_src, k)
+
+        is_last = step >= jnp.int32(self.max_round)
+        iter_val = jnp.where(
+            deg_col > 0,
+            (base + jnp.asarray(d, dt) * gathered)
+            / jnp.maximum(deg_col, 1).astype(dt),
+            base,
+        )
+        final_val = gathered * jnp.asarray(d, dt) + base
+        rank_col = jnp.where(
+            vmask_col, jnp.where(is_last, final_val, iter_val), zero
+        )
+        state = dict(
+            state,
+            rank_col=rank_col,
+            rank_row=_transpose(rank_col, k),
+            step=step,
+            dangling_sum=dangling_sum,
+        )
+        return state, jnp.where(is_last, jnp.int32(0), jnp.int32(1))
+
+    def finalize(self, frag, state):
+        # compact the gpid-space rank into [fnum, vc] rows aligned with
+        # inner_oids order (masters = diagonal fragments)
+        rank = np.asarray(state["rank_col"]).reshape(frag.k, frag.vc)
+        out = np.zeros((frag.fnum, frag.vc), dtype=rank.dtype)
+        for c in range(frag.k):
+            oids = frag.inner_oids(c * frag.k + c)
+            offs = oids % frag.chunk
+            out[c * frag.k + c, : len(oids)] = rank[c, offs]
+        return out
+
+
+class PageRankVCReplicated(GatherScatterAppBase):
+    """Round-1 formulation: master state mesh-replicated ([n_pad] per
+    device), gather = one psum over the frag axis.  O(N) memory per
+    device — kept for A/B against the SUMMA-sharded default."""
+
     load_strategy = LoadStrategy.kNullLoadStrategy
     message_strategy = MessageStrategy.kGatherScatter
     result_format = "float"

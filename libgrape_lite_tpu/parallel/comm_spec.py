@@ -15,6 +15,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 FRAG_AXIS = "frag"
+VC_ROW_AXIS = "vcrow"  # 2-D vertex-cut mesh: fragment (i, j) = device i*k+j
+VC_COL_AXIS = "vccol"
 kCoordinatorRank = 0  # reference grape/config.h:64
 
 
@@ -62,6 +64,19 @@ class CommSpec:
         self.mesh = Mesh(np.array(self.devices), (FRAG_AXIS,))
         self.worker_num = fnum
         self.worker_id = jax.process_index()
+
+    def mesh2d(self) -> Mesh:
+        """k x k (row, col) mesh over the same devices in the same
+        order (fid = i*k + j) — the SUMMA view for vertex-cut apps
+        (reference `VCPartitioner`'s 2-D fragment grid,
+        `partitioner.h:269-330`).  psum over one axis reduces a row or
+        column of fragments; a transpose is one `ppermute`."""
+        k = int(round(np.sqrt(self.fnum)))
+        if k * k != self.fnum:
+            raise ValueError(f"2-D mesh needs fnum = k^2, got {self.fnum}")
+        return Mesh(
+            np.array(self.devices).reshape(k, k), (VC_ROW_AXIS, VC_COL_AXIS)
+        )
 
     def frag_to_worker(self, fid: int) -> int:
         return fid  # identity, like the reference

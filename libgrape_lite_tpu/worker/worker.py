@@ -37,15 +37,15 @@ from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
 _INT32_MAX = np.iinfo(np.int32).max
 
 
-def _squeeze_state(state, replicated):
+def _squeeze_state(state, squeezed):
     return {
-        k: (v if k in replicated else v[0]) for k, v in state.items()
+        k: (v[0] if k in squeezed else v) for k, v in state.items()
     }
 
 
-def _unsqueeze_state(state, replicated):
+def _unsqueeze_state(state, squeezed):
     return {
-        k: (v if k in replicated else v[None]) for k, v in state.items()
+        k: (v[None] if k in squeezed else v) for k, v in state.items()
     }
 
 
@@ -82,14 +82,40 @@ class Worker:
 
     # ---- Init (reference worker.h:82-100) is construction above ----
 
+    def _mesh_layout(self):
+        """(mesh, frag/dim0 spec) for the app's mesh kind: the 1-D frag
+        axis by default, the k x k SUMMA mesh for vc2d apps."""
+        if self.app.mesh_kind == "vc2d":
+            from libgrape_lite_tpu.parallel.comm_spec import (
+                VC_COL_AXIS, VC_ROW_AXIS,
+            )
+
+            return self.comm_spec.mesh2d(), P((VC_ROW_AXIS, VC_COL_AXIS))
+        return self.comm_spec.mesh, P(FRAG_AXIS)
+
+    def _key_specs(self, state):
+        """(spec per state key, keys squeezed of their leading frag
+        dim).  Custom-spec leaves pass through as raw per-shard blocks."""
+        app = self.app
+        custom = app.custom_specs()
+        replicated = set(app.replicated_keys)
+        _, shard0 = self._mesh_layout()
+        specs = {
+            k: custom.get(k, P() if k in replicated else shard0)
+            for k in state
+        }
+        squeezed = {
+            k for k in state if k not in custom and k not in replicated
+        }
+        return specs, squeezed
+
     def _make_runner(self, max_rounds: int):
         app = self.app
-        mesh = self.comm_spec.mesh
-        replicated = set(app.replicated_keys)
+        mesh, frag_spec = self._mesh_layout()
 
-        def stepper(frag_stacked, state):
+        def stepper(frag_stacked, state, squeezed):
             frag = frag_stacked.local()
-            st = _squeeze_state(state, replicated)
+            st = _squeeze_state(state, squeezed)
             ctx = StepContext()
 
             st, active = app.peval(ctx, frag, st)
@@ -107,17 +133,12 @@ class Worker:
             st, active, rounds = lax.while_loop(
                 cond, body, (st, jnp.int32(active), jnp.int32(0))
             )
-            return _unsqueeze_state(st, replicated), rounds, active
-
-        frag_spec = P(FRAG_AXIS)
+            return _unsqueeze_state(st, squeezed), rounds, active
 
         def compile_for(state):
-            specs = {
-                k: (P() if k in replicated else P(FRAG_AXIS))
-                for k in state
-            }
+            specs, squeezed = self._key_specs(state)
             sm = jax.shard_map(
-                stepper,
+                partial(stepper, squeezed=squeezed),
                 mesh=mesh,
                 in_specs=(frag_spec, specs),
                 out_specs=(specs, P(), P()),
@@ -175,14 +196,12 @@ class Worker:
 
     def _place_state(self, state_np):
         """device_put the init state: sharded leaves over the frag axis,
-        declared-replicated leaves everywhere."""
-        shard = self.comm_spec.sharded()
-        repl = self.comm_spec.replicated()
+        declared-replicated leaves everywhere, custom-spec leaves per
+        their declared PartitionSpec."""
+        mesh, _ = self._mesh_layout()
+        specs, _ = self._key_specs(state_np)
         return {
-            k: jax.device_put(
-                jnp.asarray(v),
-                repl if k in self.app.replicated_keys else shard,
-            )
+            k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, specs[k]))
             for k, v in state_np.items()
         }
 
@@ -191,14 +210,12 @@ class Worker:
         block shared by query_stepwise; `query` fuses the whole loop via
         _make_runner instead."""
         app = self.app
-        replicated = set(app.replicated_keys)
-        specs = {
-            k: (P() if k in replicated else P(FRAG_AXIS)) for k in state
-        }
+        mesh, frag_spec = self._mesh_layout()
+        specs, squeezed = self._key_specs(state)
 
         def fn(frag_stacked, st):
             lf = frag_stacked.local()
-            s = _squeeze_state(st, replicated)
+            s = _squeeze_state(st, squeezed)
             from libgrape_lite_tpu.app.base import StepContext
 
             ctx = StepContext()
@@ -206,11 +223,11 @@ class Worker:
                 app.peval(ctx, lf, s) if kind == "peval"
                 else app.inceval(ctx, lf, s)
             )
-            return _unsqueeze_state(s2, replicated), jnp.int32(active)
+            return _unsqueeze_state(s2, squeezed), jnp.int32(active)
 
         return jax.jit(
             jax.shard_map(
-                fn, mesh=self.comm_spec.mesh, in_specs=(P(FRAG_AXIS), specs),
+                fn, mesh=mesh, in_specs=(frag_spec, specs),
                 out_specs=(specs, P()), check_vma=False,
             )
         )
