@@ -50,9 +50,16 @@ class KClique(AppBase):
     result_format = "int"
     host_only = True
 
+    # k=4 runs on-device (models/kclique_device.py) while the max
+    # oriented out-degree stays under this cap; beyond it the per-edge
+    # [D, D] third-level tensors explode (RMAT hubs: D≈6202 → 38M
+    # entries/edge) and the host recursion takes over
+    hub_cap = 160
+
     def __init__(self, k: int = 3):
         self.k = k
         self.total_cliques = 0
+        self.used_device_kernel = False
 
     def host_compute(self, frag, k: int | None = None):
         if k is not None:
@@ -73,29 +80,27 @@ class KClique(AppBase):
             w = _TRIANGLE_WORKERS[frag]
             w.query()
             per_apex = w.result_values()
+            self.used_device_kernel = True
             self.total_cliques = int(per_apex.sum())
             return {"count": per_apex}
 
-        # global (dense-compacted) oriented adjacency from the host CSRs
-        v_list, u_list = [], []
-        deg = np.zeros(fnum * vp, dtype=np.int64)
-        for f in range(fnum):
-            c = frag.host_oe[f]
-            e = c.num_edges
-            src_pid = f * vp + c.edge_src[:e].astype(np.int64)
-            deg_f = np.diff(c.indptr)
-            deg[f * vp : f * vp + vp] = deg_f
-            v_list.append(src_pid)
-            u_list.append(c.edge_nbr[:e].astype(np.int64))
-        v = np.concatenate(v_list) if v_list else np.zeros(0, np.int64)
-        u = np.concatenate(u_list) if u_list else np.zeros(0, np.int64)
+        if k == 4 and self._oriented_dmax(frag) <= self.hub_cap:
+            # low-degeneracy graphs: the double-ring ELL kernel
+            from libgrape_lite_tpu.models.kclique_device import (
+                KClique4Device,
+            )
+            from libgrape_lite_tpu.worker.worker import Worker
 
-        # dedup + orient toward (lower degree, lower pid)
-        pairs = np.unique(np.stack([v, u], 1), axis=0)
-        v, u = pairs[:, 0], pairs[:, 1]
-        keep = (deg[u] < deg[v]) | ((deg[u] == deg[v]) & (u < v))
-        keep &= v != u
-        v, u = v[keep], u[keep]
+            w = Worker(KClique4Device(), frag)
+            w.query()
+            per_apex = w.result_values()
+            self.used_device_kernel = True
+            self.total_cliques = int(per_apex.sum())
+            return {"count": per_apex}
+        self.used_device_kernel = False
+
+        # global (dense-compacted) oriented adjacency from the host CSRs
+        v, u = _oriented_pairs(frag)
 
         counts = np.zeros(fnum * vp, dtype=np.int64)
         if k == 1:
@@ -164,5 +169,47 @@ class KClique(AppBase):
         self.total_cliques = int(counts.sum())
         return {"count": counts.reshape(fnum, vp)}
 
+    @staticmethod
+    def _oriented_dmax(frag) -> int:
+        """Max (degree, id)-oriented out-degree — the degeneracy bound
+        that sizes the device kernel's [D, D] third-level tensors."""
+        v, _ = _oriented_pairs(frag)
+        if len(v) == 0:
+            return 0
+        return int(np.bincount(v).max())
+
     def finalize(self, frag, state):
         return np.asarray(state["count"])
+
+
+def _oriented_pairs(frag):
+    """Dedup (degree, pid)-oriented edge pairs (v, u) in global pid
+    space — the host-side form of the orientation every clique/LCC
+    kernel shares (`lcc.h` stage-1 neighbor filter).  Cached per
+    fragment: k=4 queries consult it for the hub-cap gate and the host
+    recursion reuses the same pairs."""
+    cached = _ORIENTED_PAIRS.get(frag)
+    if cached is not None:
+        return cached
+    fnum, vp = frag.fnum, frag.vp
+    v_list, u_list = [], []
+    deg = np.zeros(fnum * vp, dtype=np.int64)
+    for f in range(fnum):
+        c = frag.host_oe[f]
+        e = c.num_edges
+        deg[f * vp : (f + 1) * vp] = np.diff(c.indptr)
+        v_list.append(f * vp + c.edge_src[:e].astype(np.int64))
+        u_list.append(c.edge_nbr[:e].astype(np.int64))
+    v = np.concatenate(v_list) if v_list else np.zeros(0, np.int64)
+    u = np.concatenate(u_list) if u_list else np.zeros(0, np.int64)
+
+    pairs = np.unique(np.stack([v, u], 1), axis=0)
+    v, u = pairs[:, 0], pairs[:, 1]
+    keep = (deg[u] < deg[v]) | ((deg[u] == deg[v]) & (u < v))
+    keep &= v != u
+    cached = (v[keep], u[keep])
+    _ORIENTED_PAIRS[frag] = cached
+    return cached
+
+
+_ORIENTED_PAIRS = weakref.WeakKeyDictionary()

@@ -1,0 +1,175 @@
+"""Device k=4 clique counting over the sorted-ELL oriented DAG.
+
+Re-design of the reference's recursive clique kernel
+(`examples/analytical_apps/kclique/kclique.h` UniFragCliqueNumRecursive)
+for the k=4 level: every 4-clique has a unique DAG-rank order
+v < u < w < x under the (degree, id) orientation, so
+
+    count(v) = Σ_{u ∈ N+(v)} Σ_{w ∈ C2} |C2 ∩ N+(w)|,
+    C2 = N+(v) ∩ N+(u)
+
+— one more intersection level than the triangle kernel
+(models/lcc_beta.py).  Remote adjacency rows ride a DOUBLE ring: the
+outer ring rotates u's ELL block, the inner ring rotates w's
+(fnum² systolic steps, each a batched searchsorted).
+
+Shapes are static: per edge chunk the third level materialises
+[chunk, D, D] candidate hits, D = the graph's max oriented out-degree
+(bounded by degeneracy).  The kernel is gated by `hub_cap`
+(`models/kclique.py` falls back to the host recursion when D exceeds
+it) — the ROADMAP r1 item 3 hub-cap design: RMAT hubs (D ≈ 6202)
+would need ~38M-entry rows per edge, while LDBC-style graphs
+(p2p-31 D = 95) fit comfortably.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from libgrape_lite_tpu.models.lcc_beta import LCCBeta
+from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
+
+
+class KClique4Device(LCCBeta):
+    """Per-apex 4-clique counts (k=3's ApexTriangleCount sibling)."""
+
+    result_format = "int"
+    credit_mode = "apex"
+
+    def init_state(self, frag, **kw):
+        state = super().init_state(frag, **kw)
+        state["quad"] = np.zeros((frag.fnum, frag.vp), dtype=np.int32)
+        state.pop("lcc", None)
+        return state
+
+    def peval(self, ctx, frag, state):
+        vp, fnum = frag.vp, frag.fnum
+        n_pad = vp * fnum
+        my_fid = lax.axis_index(FRAG_AXIS).astype(jnp.int32)
+
+        ell, cnt = state["ell"], state["cnt"]
+        d = ell.shape[-1]
+        oe = frag.oe
+
+        # oriented dedup edge mask — same rule as the ELL build
+        from libgrape_lite_tpu.models.lcc import LCC
+
+        deg_local = frag.out_degree
+        deg_full = ctx.gather_state(deg_local)
+        row_pid = my_fid * vp + jnp.minimum(oe.edge_src, vp - 1)
+        d_row = deg_local[jnp.minimum(oe.edge_src, vp - 1)]
+        d_nbr = deg_full[oe.edge_nbr]
+        keep = jnp.logical_or(
+            d_nbr < d_row,
+            jnp.logical_and(d_nbr == d_row, oe.edge_nbr < row_pid),
+        )
+        keep = jnp.logical_and(LCC._dedup_mask(oe), keep)
+        keep = jnp.logical_and(keep, oe.edge_nbr != row_pid)
+
+        ep = oe.edge_src.shape[0]
+        # [chunk, d, d] third-level tensors bound the chunk size
+        c_e = max(8, min(512, (1 << 21) // max(d * d, 1)))
+        c_e = min(c_e, ep)
+        n_chunks = max(1, -(-ep // c_e))
+        nbr_fid = (oe.edge_nbr // vp).astype(jnp.int32)
+        nbr_lid = (oe.edge_nbr % vp).astype(jnp.int32)
+
+        def pass_for(quad, ru_ell, ru_cnt, cur_u, rw_ell, rw_cnt, cur_w):
+            def body(i, q):
+                start = jnp.minimum(i * c_e, ep - c_e)
+                pos0 = start + jnp.arange(c_e, dtype=jnp.int32)
+                fresh = pos0 >= i * c_e
+                srcs = lax.dynamic_slice(oe.edge_src, (start,), (c_e,))
+                nfid = lax.dynamic_slice(nbr_fid, (start,), (c_e,))
+                nlid = lax.dynamic_slice(nbr_lid, (start,), (c_e,))
+                kept = lax.dynamic_slice(keep, (start,), (c_e,))
+                sel = jnp.logical_and(
+                    jnp.logical_and(kept, fresh), nfid == cur_u
+                )
+
+                sl = jnp.minimum(srcs, vp - 1)
+                qv = ell[sl]  # [C, d] = N+(v), sorted, sentinel-padded
+                qvalid = jnp.arange(d)[None, :] < cnt[sl][:, None]
+                tgt_u = ru_ell[nlid]  # [C, d] = N+(u)
+                tcnt_u = ru_cnt[nlid]
+
+                # level 2: C2 = N+(v) ∩ N+(u), marked on qv positions
+                p2 = jax.vmap(jnp.searchsorted)(tgt_u, qv)
+                h2 = jnp.take_along_axis(
+                    tgt_u, jnp.minimum(p2, d - 1), axis=1
+                ) == qv
+                c2 = jnp.logical_and(h2, p2 < tcnt_u[:, None])
+                c2 = jnp.logical_and(c2, qvalid)
+                c2 = jnp.logical_and(c2, sel[:, None])
+
+                # level 3: for members w of C2 on shard cur_w,
+                # count |C2 ∩ N+(w)|
+                wfid = (qv // vp).astype(jnp.int32)
+                wlid = (qv % vp).astype(jnp.int32)
+                wsel = jnp.logical_and(c2, wfid == cur_w)
+                rows_w = rw_ell[jnp.minimum(wlid, vp - 1)]  # [C, d, d]
+                rcnt_w = rw_cnt[jnp.minimum(wlid, vp - 1)]  # [C, d]
+
+                t = rows_w.reshape(c_e * d, d)
+                qq = jnp.broadcast_to(
+                    qv[:, None, :], (c_e, d, d)
+                ).reshape(c_e * d, d)
+                p3 = jax.vmap(jnp.searchsorted)(t, qq)
+                h3 = jnp.take_along_axis(
+                    t, jnp.minimum(p3, d - 1), axis=1
+                ) == qq
+                h3 = jnp.logical_and(h3, p3 < rcnt_w.reshape(c_e * d, 1))
+                h3 = h3.reshape(c_e, d, d)
+                # x must itself be a C2 member; w must be a selected
+                # member resident on the current inner-ring shard
+                h3 = jnp.logical_and(h3, c2[:, None, :])
+                h3 = jnp.logical_and(h3, wsel[:, :, None])
+                cnt4 = h3.sum(axis=(1, 2)).astype(jnp.int32)
+                return q.at[jnp.where(sel, sl, vp - 1)].add(
+                    jnp.where(sel, cnt4, 0)
+                )
+
+            return lax.fori_loop(0, n_chunks, body, quad)
+
+        quad = jnp.zeros((vp,), dtype=jnp.int32)
+        if fnum == 1:
+            quad = pass_for(
+                quad, ell, cnt, jnp.int32(0), ell, cnt, jnp.int32(0)
+            )
+        else:
+            perm = [(i, (i - 1) % fnum) for i in range(fnum)]
+
+            def outer(su, carry):
+                q, ru_ell, ru_cnt = carry
+                cur_u = (my_fid + su) % fnum
+
+                def inner(sw, icarry):
+                    qi, rw_ell, rw_cnt = icarry
+                    cur_w = (my_fid + sw) % fnum
+                    qi = pass_for(
+                        qi, ru_ell, ru_cnt, cur_u, rw_ell, rw_cnt, cur_w
+                    )
+                    rw_ell = lax.ppermute(rw_ell, FRAG_AXIS, perm)
+                    rw_cnt = lax.ppermute(rw_cnt, FRAG_AXIS, perm)
+                    return qi, rw_ell, rw_cnt
+
+                # the inner ring completes a full cycle, returning the
+                # blocks to their home shard for the next outer step
+                q, _, _ = lax.fori_loop(0, fnum, inner, (q, ell, cnt))
+                ru_ell = lax.ppermute(ru_ell, FRAG_AXIS, perm)
+                ru_cnt = lax.ppermute(ru_cnt, FRAG_AXIS, perm)
+                return q, ru_ell, ru_cnt
+
+            quad, _, _ = lax.fori_loop(0, fnum, outer, (quad, ell, cnt))
+
+        out = jnp.where(frag.inner_mask, quad, 0).astype(jnp.int32)
+        return dict(state, quad=out), jnp.int32(0)
+
+    def inceval(self, ctx, frag, state):
+        return state, jnp.int32(0)
+
+    def finalize(self, frag, state):
+        return np.asarray(state["quad"]).astype(np.int64)

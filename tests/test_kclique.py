@@ -41,6 +41,60 @@ def test_kclique_counts(k, fnum):
     assert app.total_cliques == expect
 
 
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_k4_device_kernel_matches_host_recursion(fnum):
+    """The double-ring ELL kernel (models/kclique_device.py) must agree
+    with the host recursion per apex, not just in total."""
+    from libgrape_lite_tpu.models import KClique
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    rng = np.random.default_rng(11)
+    n, e = 48, 320
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    frag = build_fragment(src, dst, None, n, fnum)
+
+    dev_app = KClique()
+    w = Worker(dev_app, frag)
+    w.query(k=4)
+    assert dev_app.used_device_kernel
+    dev_counts = w.result_values()
+
+    host_app = KClique()
+    host_app.hub_cap = 0  # force the host recursion
+    w2 = Worker(host_app, frag)
+    w2.query(k=4)
+    assert not host_app.used_device_kernel
+    np.testing.assert_array_equal(dev_counts, w2.result_values())
+    assert dev_app.total_cliques == host_app.total_cliques
+    assert dev_app.total_cliques == brute_force_kcliques(n, src, dst, 4)
+
+
+def test_k4_hub_cap_falls_back_to_host():
+    """A graph whose oriented degree exceeds hub_cap must take the host
+    path and still count correctly (the RMAT-hub scenario)."""
+    from libgrape_lite_tpu.models import KClique
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    # star + clique: the star hub has huge degree, the clique has the
+    # 4-cliques; the hub's oriented list (toward its leaves) blows the cap
+    n_star, kq = 40, 6
+    hub = 0
+    clique = list(range(n_star + 1, n_star + 1 + kq))
+    edges = [(hub, leaf) for leaf in range(1, n_star + 1)]
+    edges += [(a, b) for i, a in enumerate(clique) for b in clique[i + 1:]]
+    src = np.array([a for a, _ in edges])
+    dst = np.array([b for _, b in edges])
+    n = n_star + 1 + kq
+    frag = build_fragment(src, dst, None, n, 2)
+    app = KClique()
+    app.hub_cap = 8
+    w = Worker(app, frag)
+    w.query(k=4)
+    assert not app.used_device_kernel  # hub exceeded the cap
+    assert app.total_cliques == brute_force_kcliques(n, src, dst, 4)
+
+
 def test_cli_query_kwargs_dispatch():
     """Every registered app name must resolve its query kwargs without
     falling through to {} when it has parameters (regression: bc/kcore
