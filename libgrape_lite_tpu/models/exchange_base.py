@@ -16,6 +16,7 @@ from __future__ import annotations
 import weakref
 
 import jax.numpy as jnp
+import numpy as np
 
 from libgrape_lite_tpu.app.base import AppBase
 from libgrape_lite_tpu.ops.segment import segment_reduce
@@ -43,6 +44,14 @@ def exchange_relax(oe, cand, valid, cap: int, fnum: int, vp: int, neutral):
 
 class ExchangeAppBase(AppBase):
     host_only = True  # data-dependent host loops (capacity retry, modes)
+
+    @staticmethod
+    def _dist_dtype(frag):
+        """Distance dtype: the edge-weight dtype when it is a float,
+        f32 otherwise (shared by every distance-carrying exchange app;
+        BFSMsg overrides — levels never depend on edge data)."""
+        dt = frag.host_oe[0].edge_w.dtype if frag.weighted else np.float32
+        return dt if np.dtype(dt).kind == "f" else np.float32
 
     def __init__(self, initial_capacity: int | None = None):
         # None = derive from the graph at query time via

@@ -57,11 +57,6 @@ class SSSPDelta(ExchangeAppBase):
 
         self._delta_cache = weakref.WeakKeyDictionary()
 
-    @staticmethod
-    def _dist_dtype(frag):
-        dt = frag.host_oe[0].edge_w.dtype if frag.weighted else np.float32
-        return dt if np.dtype(dt).kind == "f" else np.float32
-
     def _resolve_delta(self, frag) -> float:
         if self.delta is not None and self.delta > 0:
             return float(self.delta)

@@ -44,11 +44,6 @@ class SSSPMsg(ExchangeAppBase):
         """Per-edge message value: relaxation candidate."""
         return dist_at_src + oe.edge_w
 
-    @staticmethod
-    def _dist_dtype(frag):
-        dt = frag.host_oe[0].edge_w.dtype if frag.weighted else np.float32
-        return dt if np.dtype(dt).kind == "f" else np.float32
-
     def host_compute(self, frag, source=0, max_rounds: int | None = None):
         comm_spec = frag.comm_spec
         fnum, vp = frag.fnum, frag.vp

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Two-process jax.distributed dryrun — the multi-host (DCN) analogue
+of the reference's `mpirun -n 2` CI lane (`misc/app_tests.sh:231-238`).
+
+Exercises `CommSpec.init_distributed` (parallel/comm_spec.py): each
+process brings up the distributed runtime, contributes its local CPU
+devices to the global frag mesh, and the two run a psum + ring
+ppermute over a globally-sharded array — the collective patterns every
+app uses, now crossing a process boundary (the reference's
+PROCESS BOUNDARY marks in SURVEY.md §3.1).
+
+Usage:
+  python scripts/multihost_dryrun.py            # parent: spawns 2 workers
+  python scripts/multihost_dryrun.py --worker I # child process I
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+COORD = "127.0.0.1:57431"
+NPROC = 2
+LOCAL_DEVICES = 2  # per process -> 4 global
+
+
+def worker(pid: int) -> None:
+    import jax
+
+    # pin CPU before any backend init (the sandbox's sitecustomize
+    # registers the axon plugin; env vars alone do not stop it)
+    jax.config.update("jax_platforms", "cpu")
+
+    from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS, CommSpec
+
+    comm_spec = CommSpec.init_distributed(
+        coordinator_address=COORD, num_processes=NPROC, process_id=pid
+    )
+    assert comm_spec.fnum == NPROC * LOCAL_DEVICES, (
+        f"expected {NPROC * LOCAL_DEVICES} global devices, got "
+        f"{comm_spec.fnum}"
+    )
+    assert comm_spec.worker_id == pid
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    fnum = comm_spec.fnum
+    vp = 8
+    sharding = NamedSharding(comm_spec.mesh, P(FRAG_AXIS))
+
+    # each process materialises only its addressable shards
+    def make(cb):
+        return jax.make_array_from_callback((fnum, vp), sharding, cb)
+
+    x = make(lambda idx: np.full(
+        (1, vp), float(idx[0].start if idx[0].start else 0), np.float32
+    ))
+
+    def step(xs):
+        local = xs[0]
+        total = lax.psum(local.sum(), FRAG_AXIS)  # termination-vote shape
+        fid = lax.axis_index(FRAG_AXIS)
+        ring = [(i, (i + 1) % fnum) for i in range(fnum)]
+        passed = lax.ppermute(local, FRAG_AXIS, ring)  # mirror exchange
+        return (passed + total)[None], total
+
+    fn = jax.jit(
+        jax.shard_map(
+            step, mesh=comm_spec.mesh, in_specs=(P(FRAG_AXIS),),
+            out_specs=(P(FRAG_AXIS), P()), check_vma=False,
+        )
+    )
+    out, total = fn(x)
+    got = float(np.asarray(total))
+    want = float(sum(f * vp for f in range(fnum)))
+    assert got == want, f"psum across processes: got {got}, want {want}"
+    # every shard received its ring predecessor's block
+    local_out = [np.asarray(s.data) for s in out.addressable_shards]
+    assert all(np.isfinite(b).all() for b in local_out)
+    print(f"[worker {pid}] ok: fnum={fnum}, psum={got}", flush=True)
+
+
+def main() -> int:
+    if "--worker" in sys.argv:
+        worker(int(sys.argv[sys.argv.index("--worker") + 1]))
+        return 0
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}"
+    ).strip()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker", str(i)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for i in range(NPROC)
+    ]
+    ok = True
+    for i, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        text = out.decode(errors="replace")
+        print(f"--- worker {i} (rc={p.returncode}) ---\n{text}")
+        ok = ok and p.returncode == 0 and "ok:" in text
+    print("multihost_dryrun:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

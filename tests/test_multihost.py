@@ -1,0 +1,19 @@
+"""Multi-host (DCN) lane: 2-process jax.distributed dryrun driving
+CommSpec.init_distributed — the reference exercises its multi-process
+story with `mpirun -n N` in CI (`misc/app_tests.sh:231-238`)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_distributed_dryrun():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "multihost_dryrun.py")],
+        capture_output=True, timeout=240, text=True,
+        env={k: v for k, v in os.environ.items() if k != "XLA_FLAGS"},
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "multihost_dryrun: PASS" in r.stdout
