@@ -1,0 +1,33 @@
+"""Shared measurement core for the TPU probe scripts.
+
+`block_until_ready` does NOT drain the remote queue under the axon
+tunnel — timings without a data-dependent device->host readback are
+fiction (prim_bench once reported 6,674 "TFLOPS" that way).  Every
+timing here therefore ends in a real device_get of one element.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def sync(x):
+    """Force a real device->host readback of one element."""
+    import jax
+    import numpy as np
+
+    leaf = jax.tree.leaves(x)[0]
+    return np.asarray(leaf.ravel()[:1])
+
+
+def timeit(fn, *args, iters=5):
+    """Average seconds per call over `iters` dispatches, amortizing one
+    readback at the end (the queue is FIFO, so the final sync waits for
+    all dispatched iterations)."""
+    out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / iters

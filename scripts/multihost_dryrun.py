@@ -10,8 +10,8 @@ app uses, now crossing a process boundary (the reference's
 PROCESS BOUNDARY marks in SURVEY.md §3.1).
 
 Usage:
-  python scripts/multihost_dryrun.py            # parent: spawns 2 workers
-  python scripts/multihost_dryrun.py --worker I # child process I
+  python scripts/multihost_dryrun.py                  # parent: spawns 2 workers
+  python scripts/multihost_dryrun.py --worker I ADDR  # child process I
 """
 
 from __future__ import annotations
@@ -23,12 +23,19 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-COORD = "127.0.0.1:57431"
 NPROC = 2
 LOCAL_DEVICES = 2  # per process -> 4 global
 
 
-def worker(pid: int) -> None:
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def worker(pid: int, coord: str) -> None:
     import jax
 
     # pin CPU before any backend init (the sandbox's sitecustomize
@@ -38,7 +45,7 @@ def worker(pid: int) -> None:
     from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS, CommSpec
 
     comm_spec = CommSpec.init_distributed(
-        coordinator_address=COORD, num_processes=NPROC, process_id=pid
+        coordinator_address=coord, num_processes=NPROC, process_id=pid
     )
     assert comm_spec.fnum == NPROC * LOCAL_DEVICES, (
         f"expected {NPROC * LOCAL_DEVICES} global devices, got "
@@ -81,17 +88,28 @@ def worker(pid: int) -> None:
     got = float(np.asarray(total))
     want = float(sum(f * vp for f in range(fnum)))
     assert got == want, f"psum across processes: got {got}, want {want}"
-    # every shard received its ring predecessor's block
-    local_out = [np.asarray(s.data) for s in out.addressable_shards]
-    assert all(np.isfinite(b).all() for b in local_out)
+    # every shard received its ring predecessor's block: shard j was
+    # filled with the constant j, so after the ring ppermute + psum it
+    # must hold ((j-1) mod fnum) + want exactly
+    for s in out.addressable_shards:
+        j = s.index[0].start or 0
+        expect = ((j - 1) % fnum) + want
+        block = np.asarray(s.data)
+        assert (block == expect).all(), (
+            f"shard {j}: expected predecessor value {expect}, got {block}"
+        )
     print(f"[worker {pid}] ok: fnum={fnum}, psum={got}", flush=True)
 
 
 def main() -> int:
     if "--worker" in sys.argv:
-        worker(int(sys.argv[sys.argv.index("--worker") + 1]))
+        i = sys.argv.index("--worker")
+        worker(int(sys.argv[i + 1]), sys.argv[i + 2])
         return 0
 
+    import time
+
+    coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "")
@@ -99,19 +117,29 @@ def main() -> int:
     ).strip()
     procs = [
         subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--worker", str(i)],
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", str(i), coord],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
         for i in range(NPROC)
     ]
+    # one shared deadline for ALL workers (not 180s each): callers wrap
+    # this script in their own timeout, and sequential per-worker waits
+    # would overshoot it while orphaning the rest of the gang
+    deadline = time.monotonic() + 180
     ok = True
-    for i, p in enumerate(procs):
+    outs = []
+    for p in procs:
         try:
-            out, _ = p.communicate(timeout=180)
+            out, _ = p.communicate(timeout=max(1.0, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
-            p.kill()
+            for q in procs:  # a hung gang must die together
+                if q.poll() is None:
+                    q.kill()
             out, _ = p.communicate()
             ok = False
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
         text = out.decode(errors="replace")
         print(f"--- worker {i} (rc={p.returncode}) ---\n{text}")
         ok = ok and p.returncode == 0 and "ok:" in text

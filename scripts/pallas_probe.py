@@ -1,0 +1,175 @@
+"""Probe Mosaic/Pallas primitive throughput on the real TPU.
+
+Measures the building blocks for a Pallas SpMV (results print one line
+per case, cheap cases first):
+  1. lane dynamic_gather  out[i,j] = tab[idx[i,j]]   (128-entry table)
+  2. sublane dynamic_gather out[i,j] = tab[idx[i,j], j]  (S-row tables)
+  3. VPU stream + in-tile cumsum rate
+  4. dense matvec rate (the MXU N=1 reference point)
+
+    python scripts/pallas_probe.py [--e_log 22] [--block 512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+from _benchutil import sync, timeit  # noqa: E402,F401
+
+
+def emit(name, t_s, e):
+    print(
+        json.dumps(
+            {
+                "case": name,
+                "ms": round(t_s * 1e3, 3),
+                "gelem_s": round(e / t_s / 1e9, 2),
+            }
+        ),
+        flush=True,
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--e_log", type=int, default=22)
+    ap.add_argument("--block", type=int, default=512)  # sublane rows / block
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    E = 1 << args.e_log
+    B = args.block  # sublane rows per program; lanes always 128
+    rows = E // 128
+    assert rows % B == 0 and rows >= B, (
+        f"E=2^{args.e_log} gives {rows} sublane rows; --block must divide it"
+    )
+    grid = rows // B
+    rng = np.random.default_rng(0)
+    print(f"E={E} grid={grid} block=({B},128)", file=sys.stderr)
+
+    # ---- VPU stream baseline ----
+    a_np = rng.random((rows, 128)).astype(np.float32)
+    a = jnp.asarray(a_np)
+
+    def vpu_kernel(a_ref, out_ref):
+        out_ref[...] = a_ref[...] * 2.0 + 1.0
+
+    @jax.jit
+    def vpu(a):
+        return pl.pallas_call(
+            vpu_kernel,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((B, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((B, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+        )(a)
+
+    emit("vpu_stream", timeit(vpu, a, iters=args.iters), E)
+
+    # ---- 1. lane gather from a 128-entry table ----
+    idx_np = rng.integers(0, 128, size=(rows, 128)).astype(np.int32)
+    idx = jnp.asarray(idx_np)
+    tab128 = jnp.asarray(rng.random((8, 128)).astype(np.float32))
+
+    def lane_kernel(tab_ref, idx_ref, out_ref):
+        tab = tab_ref[0:1]  # [1, 128]
+        idx = idx_ref[...]  # [B, 128]
+        tab_b = jnp.broadcast_to(tab, idx.shape)
+        out_ref[...] = jnp.take_along_axis(tab_b, idx, axis=1)
+
+    @jax.jit
+    def lane_gather(tab, idx):
+        return pl.pallas_call(
+            lane_kernel,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((8, 128), lambda i: (0, 0)),
+                pl.BlockSpec((B, 128), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((B, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+        )(tab128, idx)
+
+    try:
+        emit("lane_gather_t128", timeit(lane_gather, tab128, idx, iters=args.iters), E)
+    except Exception as ex:
+        print(f"lane_gather_t128 FAIL {type(ex).__name__}: {str(ex)[:300]}",
+              flush=True)
+
+    # ---- 2. sublane gather: tab [S, 128], out[i,j] = tab[idx[i,j], j] ----
+    for S in (8, 64, 512, 8192):
+        idxs = jnp.asarray(
+            rng.integers(0, S, size=(rows, 128)).astype(np.int32)
+        )
+        tabs = jnp.asarray(rng.random((S, 128)).astype(np.float32))
+
+        def sub_kernel(tab_ref, idx_ref, out_ref):
+            tab = tab_ref[...]  # [S, 128]
+            idx = idx_ref[...]  # [B, 128]
+            # out[i, j] = tab[idx[i, j], j] — gather along sublanes,
+            # batched along lanes
+            out_ref[...] = jnp.take_along_axis(tab, idx, axis=0)
+
+        @jax.jit
+        def sub_gather(tab, idx, S=S):
+            return pl.pallas_call(
+                sub_kernel,
+                grid=(grid,),
+                in_specs=[
+                    pl.BlockSpec((S, 128), lambda i: (0, 0)),
+                    pl.BlockSpec((B, 128), lambda i: (i, 0)),
+                ],
+                out_specs=pl.BlockSpec((B, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+            )(tab, idx)
+
+        try:
+            emit(f"sublane_gather_S{S}",
+                 timeit(sub_gather, tabs, idxs, iters=args.iters), E)
+        except Exception as ex:
+            print(
+                f"sublane_gather_S{S} FAIL {type(ex).__name__}: {str(ex)[:300]}",
+                flush=True,
+            )
+
+    # ---- 3. in-tile cumsum along lanes ----
+    def cs_kernel(a_ref, out_ref):
+        out_ref[...] = jnp.cumsum(a_ref[...], axis=1)
+
+    @jax.jit
+    def cs(a):
+        return pl.pallas_call(
+            cs_kernel,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((B, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((B, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+        )(a)
+
+    try:
+        emit("cumsum_lanes", timeit(cs, a, iters=args.iters), E)
+    except Exception as ex:
+        print(f"cumsum_lanes FAIL {type(ex).__name__}: {str(ex)[:300]}",
+              flush=True)
+
+    # ---- 4. dense matvec (XLA) ----
+    m = jnp.asarray(rng.random((8192, 8192)).astype(np.float32))
+    v = jnp.asarray(rng.random((8192,)).astype(np.float32))
+    mv = jax.jit(lambda m, v: m @ v)
+    emit("dense_matvec_8192_f32", timeit(mv, m, v, iters=args.iters),
+         8192 * 8192)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Microbenchmark the SpMV-path primitives on the default backend.
+
+Isolates where a PageRank round's time goes: the gather (x[nbr]), the
+sorted segment_sum (scatter side), the fused gather+segment_sum, and a
+dense-matmul calibration point for the chip's ceiling.
+
+    python scripts/prim_bench.py [--scale 20] [--ef 16] [--iters 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+from _benchutil import sync, timeit  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=20)
+    ap.add_argument("--ef", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench
+    from libgrape_lite_tpu.ops.segment import segment_reduce
+
+    n, src, dst = bench.rmat_edges(args.scale, args.ef)
+    # symmetrised CSR order like the fragment stores in-edges
+    s2 = np.concatenate([src, dst])
+    d2 = np.concatenate([dst, src])
+    order = np.argsort(s2, kind="stable")
+    row = jnp.asarray(s2[order].astype(np.int32))
+    col = jnp.asarray(d2[order].astype(np.int32))
+    e = len(s2)
+    x = jnp.asarray(np.random.default_rng(0).random(n).astype(np.float32))
+    vals = jnp.asarray(np.random.default_rng(1).random(e).astype(np.float32))
+    print(f"platform={jax.devices()[0].platform} E={e} N={n}", file=sys.stderr)
+
+    res = {}
+
+    tiny = jnp.zeros((8,), jnp.float32)
+    noop = jax.jit(lambda v: v + 1)
+    res["noop_roundtrip_ms"] = timeit(noop, tiny, iters=args.iters) * 1e3
+
+    gather = jax.jit(lambda x, c: x[c])
+    res["gather_ms"] = timeit(gather, x, col, iters=args.iters) * 1e3
+
+    segsum = jax.jit(lambda v, r: segment_reduce(v, r, n, "sum"))
+    res["segment_sum_sorted_ms"] = timeit(segsum, vals, row, iters=args.iters) * 1e3
+
+    seg_unsorted = jax.jit(
+        lambda v, r: jax.ops.segment_sum(v, r, num_segments=n)
+    )
+    res["segment_sum_unsorted_ms"] = (
+        timeit(seg_unsorted, vals, row, iters=args.iters) * 1e3
+    )
+
+    fused = jax.jit(lambda x, c, r: segment_reduce(x[c], r, n, "sum"))
+    res["gather_segsum_fused_ms"] = timeit(fused, x, col, row, iters=args.iters) * 1e3
+
+    # gather with SORTED indices (repeat-like): cost of the expand side
+    gather_sorted = jax.jit(lambda x, r: x[r])
+    res["gather_sorted_ms"] = timeit(gather_sorted, x, row, iters=args.iters) * 1e3
+
+    # one-hot matmul calibration: [8192, 2048] @ [2048, 128] f32
+    a = jnp.ones((8192, 2048), jnp.float32)
+    b = jnp.ones((2048, 128), jnp.float32)
+    mm = jax.jit(lambda a, b: a @ b)
+    t = timeit(mm, a, b, iters=args.iters)
+    res["matmul_8192x2048x128_ms"] = t * 1e3
+    res["matmul_tflops"] = 2 * 8192 * 2048 * 128 / t / 1e12
+
+    # big matmul ceiling: 4096^3
+    c1 = jnp.ones((4096, 4096), jnp.float32)
+    mm2 = jax.jit(lambda a: a @ a)
+    t = timeit(mm2, c1, iters=args.iters)
+    res["matmul4096_tflops_f32"] = 2 * 4096**3 / t / 1e12
+    c2 = c1.astype(jnp.bfloat16)
+    mm3 = jax.jit(lambda a: (a @ a))
+    t = timeit(mm3, c2, iters=args.iters)
+    res["matmul4096_tflops_bf16"] = 2 * 4096**3 / t / 1e12
+
+    # HBM bandwidth calibration: big copy
+    big = jnp.ones((1 << 27,), jnp.float32)  # 512 MB
+    cp = jax.jit(lambda v: v * 2.0)
+    t = timeit(cp, big, iters=args.iters)
+    res["hbm_gbps_rw"] = 2 * big.nbytes / t / 1e9
+
+    # sort calibration (CDLP-style): 33.5M int32 keys
+    keys = col.astype(jnp.int32)
+    st = jax.jit(lambda k: jnp.sort(k))
+    res["sort_e_int32_ms"] = timeit(st, keys, iters=args.iters) * 1e3
+
+    for k, v in res.items():
+        res[k] = round(v, 3)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Wide-row gather/scatter microbenchmarks on the default backend.
+
+Hypothesis: XLA TPU element-gather runs ~8.7ns/elem (serial), but
+gathering W-wide ROWS lowers to per-row DMA near bandwidth.  If true,
+SpMV = row-gather + in-row one-hot select + one-hot spread +
+row-segment-sum beats the element path ~50x.
+
+    python scripts/prim_bench2.py [--scale 20] [--ef 16] [--iters 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+from _benchutil import sync, timeit  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=20)
+    ap.add_argument("--ef", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench
+
+    n, src, dst = bench.rmat_edges(args.scale, args.ef)
+    s2 = np.concatenate([src, dst])
+    d2 = np.concatenate([dst, src])
+    order = np.argsort(s2, kind="stable")
+    row_np = s2[order].astype(np.int32)
+    col_np = d2[order].astype(np.int32)
+    row = jnp.asarray(row_np)
+    col = jnp.asarray(col_np)
+    e = len(row_np)
+    x = jnp.asarray(np.random.default_rng(0).random(n).astype(np.float32))
+    print(f"platform={jax.devices()[0].platform} E={e} N={n}", file=sys.stderr)
+
+    res = {}
+
+    for w in (8, 16, 32):
+        lg = int(np.log2(w))
+        x2 = x.reshape(n >> lg, w)
+        ridx = col >> lg
+
+        # row gather alone
+        rg = jax.jit(lambda x2, r: x2[r])
+        res[f"rowgather_w{w}_ms"] = timeit(rg, x2, ridx, iters=args.iters) * 1e3
+
+        # row gather + in-row one-hot select = full gather x[col]
+        def gsel(x2, c, lg=lg, w=w):
+            rows = x2[c >> lg]  # [E, w]
+            lane = (c & (w - 1))[:, None]
+            oh = (lane == jnp.arange(w, dtype=c.dtype)[None, :]).astype(
+                rows.dtype
+            )
+            return (rows * oh).sum(axis=1)
+
+        gselj = jax.jit(gsel)
+        res[f"gather_via_rows_w{w}_ms"] = (
+            timeit(gselj, x2, col, iters=args.iters) * 1e3
+        )
+
+        # scatter side: one-hot spread + segment_sum of [E, w] rows
+        def ssum(v, r, lg=lg, w=w):
+            lane = (r & (w - 1))[:, None]
+            oh = (lane == jnp.arange(w, dtype=r.dtype)[None, :]).astype(v.dtype)
+            out2 = jax.ops.segment_sum(
+                v[:, None] * oh, r >> lg, num_segments=n >> lg,
+                indices_are_sorted=True,
+            )
+            return out2.reshape(-1)
+
+        vals = jnp.ones((e,), jnp.float32)
+        ssumj = jax.jit(ssum)
+        res[f"segsum_via_rows_w{w}_ms"] = (
+            timeit(ssumj, vals, row, iters=args.iters) * 1e3
+        )
+
+        # full SpMV via rows
+        def spmv(x2, c, r, lg=lg, w=w):
+            v = gsel(x2, c, lg, w)
+            return ssum(v, r, lg, w)
+
+        spmvj = jax.jit(spmv)
+        res[f"spmv_via_rows_w{w}_ms"] = (
+            timeit(spmvj, x2, col, row, iters=args.iters) * 1e3
+        )
+
+    # reference point: element segment_sum on [E] (the r1 path)
+    vals = jnp.ones((e,), jnp.float32)
+    seg1 = jax.jit(
+        lambda v, r: jax.ops.segment_sum(
+            v, r, num_segments=n, indices_are_sorted=True
+        )
+    )
+    res["segsum_elem_ms"] = timeit(seg1, vals, row, iters=args.iters) * 1e3
+
+    # dense-row segment_sum WITHOUT one-hot spread (pure row reduce):
+    # bounds how much of segsum_via_rows is the spread vs the reduce
+    v8 = jnp.ones((e, 8), jnp.float32)
+    segr = jax.jit(
+        lambda v, r: jax.ops.segment_sum(
+            v, r >> 3, num_segments=n >> 3, indices_are_sorted=True
+        )
+    )
+    res["segsum_rows8_pre_ms"] = timeit(segr, v8, row, iters=args.iters) * 1e3
+
+    for k, v in res.items():
+        res[k] = round(v, 3)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
