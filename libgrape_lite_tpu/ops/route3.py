@@ -1,0 +1,243 @@
+"""Static 3-stage shuffle: arbitrary within-block permutations on TPU.
+
+TPU vector units move data fast only along two axes: within a sublane
+row (lane gather, `take_along_axis(axis=1)`) and within a lane column
+(sublane gather, `take_along_axis(axis=0)`, which Mosaic lowers when
+table and index shapes match).  An ARBITRARY static permutation of an
+[R, 128] block factors into three such moves — row-perm, column-perm,
+row-perm — exactly a rearrangeable 3-stage Clos network
+(Slepian-Duguid): route element e (src slot -> dst slot) through a
+"middle lane" m(e) such that every source row uses each middle lane
+once and every middle lane hits each destination row once.  Such an
+assignment always exists: it is an edge coloring of the C-regular
+bipartite multigraph (src rows x dst rows) with C = 128 colors, which
+Koenig's theorem guarantees.  We compute it with the classic Euler
+-split recursion, fully vectorized (orbit labels by pointer doubling
+instead of walking cycles).
+
+This is the data-movement backbone of the pack-gather SpMV
+(`ops/spmv_pack.py`); the reference's counterpart machinery is the
+CUDA load-balancing/shuffle layer (`grape/cuda/parallel/
+parallel_engine.h`, `grape/cuda/utils/shuffle.h`) — redesigned here
+for a machine whose fast paths are lane/sublane moves, not warp
+shuffles.
+
+Host API
+--------
+  plan_route(src_slot, dst_slot, R_src, R_dst, C=128) -> Route3
+     src_slot/dst_slot: int64 flat slot ids (row*C + lane), one entry
+     per routed element; unrouted destination slots receive garbage and
+     must be masked by the caller.  Requires len <= R_src*C and
+     <= R_dst*C; elements per src row and per dst row each <= C.
+
+Kernel API
+----------
+  apply_route3(x, route_arrays...) inside a Pallas kernel, where the
+  three int32 index blocks are fed as ordinary VMEM inputs.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class Route3(NamedTuple):
+    """Static routing program for one [R_src,C] -> [R_dst,C] shuffle.
+
+    l1 [R_src, C]: stage-1 lane gather (within src rows): stage1[r, m]
+       = x[r, l1[r, m]] — moves each element to its middle lane m.
+    s2 [R_mid, C]: stage-2 sublane gather on the stage-1 result padded
+       /sliced to R_mid = max(R_src, R_dst) rows: stage2[r, m] =
+       stage1[s2[r, m], m] — moves along the middle lane to the
+       destination row.
+    l3 [R_dst, C]: stage-3 lane gather (within dst rows): out[r, c] =
+       stage2[r, l3[r, c]].
+    valid [R_dst, C] bool: True where the dst slot received a routed
+       element (callers mask the rest).
+    """
+
+    l1: np.ndarray
+    s2: np.ndarray
+    l3: np.ndarray
+    valid: np.ndarray
+
+    @property
+    def r_mid(self) -> int:
+        return self.s2.shape[0]
+
+
+def _orbit_min_label(nxt: np.ndarray) -> np.ndarray:
+    """Min element index over each orbit of the permutation `nxt`,
+    by pointer doubling (O(E log E), no Python-level cycle walks)."""
+    lab = np.arange(len(nxt), dtype=np.int64)
+    jump = nxt.astype(np.int64)
+    # after k rounds lab[i] = min over {i, nxt(i), ..., nxt^(2^k-1)(i)}
+    steps = max(1, int(np.ceil(np.log2(max(2, len(nxt))))))
+    for _ in range(steps):
+        lab = np.minimum(lab, lab[jump])
+        jump = jump[jump]
+    return lab
+
+
+def _pair_within(groups: np.ndarray) -> np.ndarray:
+    """Pair consecutive incidences of each group value (all group
+    multiplicities even): returns for each element the index of its
+    partner.  Vectorized via stable argsort."""
+    order = np.argsort(groups, kind="stable")
+    partner_sorted = np.arange(len(groups), dtype=np.int64)
+    partner_sorted[0::2] = order[1::2]
+    partner_sorted[1::2] = order[0::2]
+    partner = np.empty(len(groups), dtype=np.int64)
+    partner[order] = partner_sorted
+    return partner
+
+
+def _euler_split(src: np.ndarray, dst: np.ndarray) -> np.ndarray:
+    """2-color the edges of a bipartite multigraph in which every
+    vertex has EVEN degree, such that each vertex's edges split evenly
+    between colors.  Returns bool color per edge.
+
+    Pair edges at each src vertex and at each dst vertex; the pairing
+    graph decomposes into even-length cycles alternating src/dst
+    pairings.  pi = dst_pair(src_pair(.)) jumps two steps, so each
+    cycle splits into two pi-orbits that must take opposite colors;
+    src_pair maps an orbit onto its partner, giving a consistent,
+    fully vectorized coloring rule: color = [orbit label < partner
+    orbit label].
+    """
+    src_pair = _pair_within(src)
+    dst_pair = _pair_within(dst)
+    pi = dst_pair[src_pair]
+    lab = _orbit_min_label(pi)
+    partner_lab = lab[src_pair]
+    # labels differ because src_pair always crosses to the other orbit
+    return lab < partner_lab
+
+
+def _edge_color(src: np.ndarray, dst: np.ndarray, C: int) -> np.ndarray:
+    """Color edges of a C-regular bipartite multigraph with C colors
+    (Koenig), by Euler-split recursion.  C must be a power of two and
+    every vertex degree exactly C."""
+    colors = np.zeros(len(src), dtype=np.int32)
+    stack = [(np.arange(len(src), dtype=np.int64), C, 0)]
+    while stack:
+        ids, c, base = stack.pop()
+        if c == 1:
+            colors[ids] = base
+            continue
+        half = _euler_split(src[ids], dst[ids])
+        stack.append((ids[half], c // 2, base))
+        stack.append((ids[~half], c // 2, base + c // 2))
+    return colors
+
+
+def plan_route(
+    src_slot: np.ndarray,
+    dst_slot: np.ndarray,
+    r_src: int,
+    r_dst: int,
+    c: int = 128,
+) -> Route3:
+    """Compute the 3-stage routing for `out.flat[dst_slot] =
+    x.flat[src_slot]` over blocks [r_src, c] -> [r_dst, c].
+
+    Each src slot and each dst slot may appear at most once.  Holes on
+    either side are padded internally with dummy elements; dst holes
+    are reported in `valid`.
+    """
+    src_slot = np.asarray(src_slot, dtype=np.int64)
+    dst_slot = np.asarray(dst_slot, dtype=np.int64)
+    if len(src_slot) != len(dst_slot):
+        raise ValueError("src/dst length mismatch")
+    r_mid = max(r_src, r_dst)
+
+    src_row = src_slot // c
+    dst_row = dst_slot // c
+
+    # pad to exact C-regularity on both sides with dummy elements:
+    # dummies pair leftover src-row capacity with leftover dst-row
+    # capacity (total capacity r_mid*c on both sides)
+    src_cnt = np.bincount(src_row, minlength=r_mid)
+    dst_cnt = np.bincount(dst_row, minlength=r_mid)
+    if (src_cnt > c).any():
+        raise ValueError("a source row holds more than C elements")
+    if (dst_cnt > c).any():
+        raise ValueError("a destination row holds more than C elements")
+    pad_src_row = np.repeat(
+        np.arange(r_mid, dtype=np.int64), (c - src_cnt).astype(np.int64)
+    )
+    pad_dst_row = np.repeat(
+        np.arange(r_mid, dtype=np.int64), (c - dst_cnt).astype(np.int64)
+    )
+    assert len(pad_src_row) == len(pad_dst_row)
+
+    all_src_row = np.concatenate([src_row, pad_src_row])
+    all_dst_row = np.concatenate([dst_row, pad_dst_row])
+    real = np.zeros(len(all_src_row), dtype=bool)
+    real[: len(src_slot)] = True
+
+    m = _edge_color(all_src_row, all_dst_row, c)
+
+    # dummy elements also need concrete src/dst lanes: give each padded
+    # row's dummies the lanes its real elements left unused
+    def _fill_lanes(rows, slots_real_rows, slots_real_lanes):
+        used = np.zeros((r_mid, c), dtype=bool)
+        used[slots_real_rows, slots_real_lanes] = True
+        free_r, free_l = np.nonzero(~used)
+        order = np.argsort(free_r, kind="stable")
+        free_r, free_l = free_r[order], free_l[order]
+        # rows of dummies arrive sorted too (np.repeat order)
+        assert (free_r == rows).all()
+        return free_l
+
+    pad_src_lane = _fill_lanes(pad_src_row, src_row, src_slot % c)
+    pad_dst_lane = _fill_lanes(pad_dst_row, dst_row, dst_slot % c)
+    all_src_lane = np.concatenate([src_slot % c, pad_src_lane])
+    all_dst_lane = np.concatenate([dst_slot % c, pad_dst_lane])
+
+    # build the three index arrays
+    l1 = np.zeros((r_mid, c), dtype=np.int32)  # [src_row, m] -> src lane
+    l1[all_src_row, m] = all_src_lane
+    s2 = np.zeros((r_mid, c), dtype=np.int32)  # [dst_row, m] -> src row
+    s2[all_dst_row, m] = all_src_row
+    l3 = np.zeros((r_mid, c), dtype=np.int32)  # [dst_row, lane] -> m
+    l3[all_dst_row, all_dst_lane] = m
+    valid = np.zeros((r_mid, c), dtype=bool)
+    valid[dst_row, dst_slot % c] = True
+
+    return Route3(l1=l1, s2=s2, l3=l3[:r_dst], valid=valid[:r_dst])
+
+
+def apply_route3_np(x: np.ndarray, rt: Route3) -> np.ndarray:
+    """Numpy reference of the kernel-side application (for tests)."""
+    r_src, c = x.shape
+    xm = x
+    if rt.s2.shape[0] > r_src:
+        xm = np.concatenate(
+            [x, np.zeros((rt.s2.shape[0] - r_src, c), x.dtype)]
+        )
+    s1 = np.take_along_axis(xm, rt.l1, axis=1)
+    s2 = np.take_along_axis(s1, rt.s2, axis=0)
+    s3 = np.take_along_axis(s2[: rt.l3.shape[0]], rt.l3, axis=1)
+    return s3
+
+
+def apply_route3(x, l1, s2, l3):
+    """Kernel-side application with jnp ops (usable in Pallas TPU
+    kernels and in interpret mode).  `x` [r_src, c] is zero-padded to
+    the middle height; index arrays are the Route3 fields (dense int32
+    blocks).  Returns [r_dst, c] — mask with Route3.valid."""
+    import jax.numpy as jnp
+
+    r_mid, c = s2.shape
+    r_src = x.shape[0]
+    if r_mid > r_src:
+        x = jnp.concatenate(
+            [x, jnp.zeros((r_mid - r_src, c), x.dtype)], axis=0
+        )
+    s1 = jnp.take_along_axis(x, l1, axis=1)
+    s2v = jnp.take_along_axis(s1, s2, axis=0)
+    r_dst = l3.shape[0]
+    return jnp.take_along_axis(s2v[:r_dst], l3, axis=1)

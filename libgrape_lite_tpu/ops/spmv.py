@@ -74,15 +74,15 @@ def plan_tiles(edge_src_sorted: np.ndarray, tile: int, vp: int):
 def _spmv_tile_kernel(row_lo_ref, src_ref, val_ref, out_ref, *, rmax):
     t = pl.program_id(0)
     row_lo = row_lo_ref[t]
-    src = src_ref[...]  # [1, tile] int32
-    val = val_ref[...].astype(jnp.float32)  # [1, tile]
+    src = src_ref[0]  # [1, tile] int32 (block [1, 1, tile])
+    val = val_ref[0].astype(jnp.float32)  # [1, tile]
     tile = src.shape[-1]
     # local row of each edge, one-hot against the tile's row window
     local = (src - row_lo).reshape(tile, 1)
     rows = jax.lax.broadcasted_iota(jnp.int32, (tile, rmax), 1)
     onehot = (local == rows).astype(jnp.float32)
     # [1, tile] @ [tile, rmax] on the MXU -> per-row partial sums
-    out_ref[...] = jnp.dot(val, onehot, preferred_element_type=jnp.float32)
+    out_ref[0] = jnp.dot(val, onehot, preferred_element_type=jnp.float32)
 
 
 @functools.partial(
@@ -98,25 +98,31 @@ def _spmv_partials(values, edge_src, row_lo, tile, rmax, num_tiles, vp,
         edge_src = jnp.concatenate(
             [edge_src, jnp.full((pad,), vp, edge_src.dtype)]
         )
+    # Mosaic requires the last two block dims to be (8,128)-divisible
+    # or equal to the array dims — a singleton middle dim satisfies
+    # that for per-tile [1, tile] blocks (r1 shipped (1, tile) 2-D
+    # blocks, which never compiled on hardware; tests/
+    # test_pallas_lowering.py now guards this offline)
     grid_spec = pl.GridSpec(
         grid=(num_tiles,),
         in_specs=[
             pl.BlockSpec((num_tiles,), lambda i: (0,)),
-            pl.BlockSpec((1, tile), lambda i: (i, 0)),
-            pl.BlockSpec((1, tile), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, tile), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, tile), lambda i: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, rmax), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((1, 1, rmax), lambda i: (i, 0, 0)),
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         functools.partial(_spmv_tile_kernel, rmax=rmax),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((num_tiles, rmax), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((num_tiles, 1, rmax), jnp.float32),
         interpret=interpret,
     )(
         row_lo,
-        edge_src.astype(jnp.int32).reshape(num_tiles, tile),
-        values.reshape(num_tiles, tile),
+        edge_src.astype(jnp.int32).reshape(num_tiles, 1, tile),
+        values.reshape(num_tiles, 1, tile),
     )
+    return out.reshape(num_tiles, rmax)
 
 
 def spmv_strict(values, edge_src, row_lo, vp: int, tile: int, rmax: int,

@@ -1,0 +1,813 @@
+"""Pack-gather SpMV: sorted segment-sum at vector-unit rate on TPU.
+
+Replaces the XLA gather + segment_sum pull (measured ~8.7 ns/element
+EACH on real v5e hardware — docs/PERF_NOTES.md) with a fully static
+Pallas pipeline in which every data movement is a lane gather, a
+sublane gather, or a static 3-stage shuffle (ops/route3.py):
+
+  per block [SUB, 128] of edge slots (host-planned, static):
+    1. GATHER   x values: non-hub edges sit at a slot whose lane is
+       the XOR-mixed `_lane_mix(col)` (plain col%128 is skewed on
+       Kronecker ids), so ONE sublane dynamic_gather from the
+       VMEM-resident, lane-mixed x-table [SUB, 128] (pass p holds
+       x[p*SUB*128:(p+1)*SUB*128]) fetches x[col] for the whole block; hub columns (the top-HUB
+       most referenced, which would overflow lane capacity) read a
+       tiny [HUB/128, 128] register table via lane gathers + selects.
+    2. ROUTE    gathered values back to CSR (row-sorted) slot order —
+       a static 3-stage shuffle.
+    3. SCAN     segmented inclusive sum over the flattened block in
+       log2(SUB*128) shift-add stages (segment starts are a static
+       flag stream).
+    4. EXTRACT  each row's last-slot scan value (= the row's partial
+       sum within the block) into a compact [OUT_SUB, 128] stream —
+       another static shuffle.
+  fold levels: the per-block partial streams are grouped (<= SUB //
+  OUT_SUB streams per group, bounded by output capacity), re-sorted by
+  row with a static shuffle, and reduced by the same scan+extract
+  kernel — recursively, until one block remains; the final level's
+  extraction targets slot == row id, so the result lands as the dense
+  [vp] output with no scatter of any kind.
+
+The reference counterpart is the CUDA LB-kernel catalog
+(`grape/cuda/parallel/parallel_engine.h:42-1444`) — the machinery that
+makes per-edge work run at hardware rate.  On TPU that machinery is
+this file: all irregularity is compiled into static routes at plan
+time; the per-round dataflow is dense vector work.
+
+Plans are built once per (fragment, dtype) and reused every round;
+planning cost is O(E log) numpy (cacheable alongside the fragment
+serialization cache).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from libgrape_lite_tpu.ops.route3 import Route3, plan_route
+
+C = 128
+
+
+def _lane_mix(local: np.ndarray) -> np.ndarray:
+    """Static lane assignment for a pass-local column id.
+
+    Plain `col % 128` is pathologically skewed on Kronecker/RMAT
+    graphs: high-degree ids have many trailing zero bits, so lane 0
+    receives ~8x its share and blocks cut at ~12% fill.  XOR-folding
+    the next id bits into the lane decorrelates degree from lane while
+    staying a bijection per table row (a per-row constant XOR), so the
+    kernel recovers the layout with one computed lane gather on the
+    x-table (`tab[r, l] = x[r*128 + (l ^ mix(r))]`)."""
+    r = local >> 7
+    return (local ^ r ^ (r >> 7)) & (C - 1)
+
+
+def _row_mix(r):
+    """The per-table-row XOR constant of `_lane_mix` (kernel side)."""
+    return (r ^ (r >> 7)) & (C - 1)
+
+
+@dataclass(frozen=True)
+class PackConfig:
+    sub: int = 4096        # sublane rows per block (block = sub*128 slots)
+    out_sub: int = 512     # sublane rows per compact output block
+    hub: int = 1024        # hub table size (multiple of 128)
+
+    @property
+    def slots(self) -> int:
+        return self.sub * C
+
+    @property
+    def max_distinct(self) -> int:
+        return self.out_sub * C
+
+
+@dataclass
+class BlockPlan:
+    """Static arrays for one [sub, 128] kernel block."""
+
+    # gather stage (None on fold levels)
+    sub_idx: Optional[np.ndarray]  # [sub, C] int16: x-table row per slot
+    hub_sel: Optional[np.ndarray]  # [sub, C] int16: hub idx, -1 if not hub
+    # CSR-restore / merge route (pack slots -> row-sorted slots)
+    route: Route3
+    flags: np.ndarray              # [sub, C] int8: bit0 valid, bit1 seg start
+    # extraction route (scanned slots -> compact out slots)
+    eroute: Route3
+    out_rows: np.ndarray           # [out_slots] int64 row id per out slot
+    out_valid: np.ndarray          # [out_slots] bool
+    n_edges: int = 0
+    n_inputs: int = 1              # fold levels: streams concatenated
+
+
+@dataclass
+class LevelPlan:
+    """One pallas_call: a list of equally-shaped blocks."""
+
+    cfg: PackConfig
+    blocks: List[BlockPlan]
+    has_gather: bool
+    pass_base: int = 0             # x-table offset (gather levels)
+    out_sub: int = 0               # output rows per block
+
+
+_PLAN_COUNTER = itertools.count()
+
+
+@dataclass
+class PackPlan:
+    vp: int                        # output length (padded, multiple of 128)
+    n_cols: int                    # gather-table length
+    cfg: PackConfig
+    hub_cols: np.ndarray           # [hub] int64 column ids (padded with 0)
+    levels: List[LevelPlan] = field(default_factory=list)
+    final: Optional[LevelPlan] = None  # single-block level -> [vp]
+    # unique id: apps bake it into trace keys so a cached runner is
+    # never reused with a different fragment's closed-over plan
+    uid: int = field(default_factory=lambda: next(_PLAN_COUNTER))
+
+    # device-side constant streams, materialized lazily per backend
+    _device: dict = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# host planning
+# --------------------------------------------------------------------------
+
+
+def _cut_blocks(rows, local_cols, hub_mask, cfg: PackConfig):
+    """Split CSR-ordered edges into block ranges such that per block:
+    no mixed lane exceeds `sub` non-hub edges, slots <= sub*128, and
+    distinct rows <= max_distinct.  Returns list of (lo, hi).
+
+    O(E): per-lane edge position lists + segment-start prefix counts
+    give each cut point in O(1)."""
+    e = len(rows)
+    lane = np.where(hub_mask, -1, _lane_mix(local_cols))
+    # per-lane position lists: pos_by_lane[l] = sorted edge indices in l
+    order = np.argsort(lane, kind="stable")
+    lane_sorted = lane[order]
+    lane_starts = np.searchsorted(lane_sorted, np.arange(C))
+    lane_ends = np.searchsorted(lane_sorted, np.arange(C), side="right")
+    pos_by_lane = [order[lane_starts[l]:lane_ends[l]] for l in range(C)]
+
+    seg_start = np.ones(e, dtype=np.int64)
+    seg_start[1:] = rows[1:] != rows[:-1]
+    cum_start = np.concatenate([[0], np.cumsum(seg_start)])
+
+    cuts = []
+    lo = 0
+    while lo < e:
+        hi = min(e, lo + cfg.slots)
+        # lane overflow: for each lane, the (rank_at_lo + sub)-th edge
+        # of that lane is the first infeasible position
+        for l in range(C):
+            pl = pos_by_lane[l]
+            r0 = np.searchsorted(pl, lo)
+            if r0 + cfg.sub < len(pl):
+                hi = min(hi, int(pl[r0 + cfg.sub]))
+        # distinct-rows bound: distinct([lo,hi)) = 1 + cum_start[hi]
+        # - cum_start[lo+1]  (the row at lo counts whether or not it is
+        # a recorded segment start); keep the largest feasible hi
+        target = cum_start[lo + 1] + cfg.max_distinct - 1
+        hi_feas = int(np.searchsorted(cum_start, target, side="right")) - 1
+        hi = min(hi, max(lo + 1, hi_feas))
+        cuts.append((lo, hi))
+        lo = hi
+    return cuts
+
+
+def _plan_gather_block(rows, cols, hub_idx, base, cfg: PackConfig):
+    """Plan one gather block from its CSR-ordered edge slice.
+
+    hub_idx: int32 per edge, -1 if the edge reads the pass table,
+    else its index into the hub table.  `base` is the pass's x offset.
+    """
+    e = len(rows)
+    sub = cfg.sub
+    is_hub = hub_idx >= 0
+
+    # ---- slot assignment: non-hub lane = mixed lane; hub fills holes ----
+    lane = np.where(is_hub, -1, _lane_mix(cols - base)).astype(np.int64)
+    slot = np.full(e, -1, dtype=np.int64)
+    # positions of non-hub edges within their lane column (stable)
+    nh = np.nonzero(~is_hub)[0]
+    order = np.argsort(lane[nh], kind="stable")
+    lane_sorted = lane[nh][order]
+    pos_in_lane = np.arange(len(nh)) - np.searchsorted(
+        lane_sorted, lane_sorted
+    )
+    slot[nh[order]] = pos_in_lane * C + lane_sorted
+    assert (pos_in_lane < sub).all(), "lane overflow despite block cut"
+    # hub edges take remaining slots (any lane)
+    hub_e = np.nonzero(is_hub)[0]
+    if len(hub_e):
+        used = np.zeros(sub * C, dtype=bool)
+        used[slot[nh]] = True
+        free = np.nonzero(~used)[0]
+        slot[hub_e] = free[: len(hub_e)]
+    assert (slot >= 0).all()
+
+    # ---- gather streams ----
+    sub_idx = np.zeros((sub, C), dtype=np.int16)
+    hub_sel = np.full((sub, C), -1, dtype=np.int16)
+    srow, slane = slot // C, slot % C
+    tab_row = np.where(is_hub, 0, (cols - base) >> 7)
+    assert (tab_row >= 0).all() and (tab_row < sub).all()
+    sub_idx[srow, slane] = tab_row.astype(np.int16)
+    hub_sel[srow[is_hub], slane[is_hub]] = hub_idx[is_hub].astype(np.int16)
+
+    # ---- CSR-restore route: pack slot -> CSR slot i ----
+    route = plan_route(slot, np.arange(e, dtype=np.int64), sub, sub)
+
+    # ---- flags for the segmented scan over CSR order ----
+    flags = np.zeros((sub, C), dtype=np.int8)
+    csr_r, csr_l = np.arange(e) // C, np.arange(e) % C
+    seg_start = np.ones(e, dtype=bool)
+    seg_start[1:] = rows[1:] != rows[:-1]
+    flags[csr_r, csr_l] = 1 | (seg_start.astype(np.int8) << 1)
+
+    # ---- extraction: each row's last CSR slot -> compact out slot ----
+    last = np.ones(e, dtype=bool)
+    last[:-1] = rows[1:] != rows[:-1]
+    src = np.nonzero(last)[0]
+    d = len(src)
+    assert d <= cfg.max_distinct
+    eroute = plan_route(
+        src, np.arange(d, dtype=np.int64), sub, cfg.out_sub
+    )
+    out_rows = np.zeros(cfg.out_sub * C, dtype=np.int64)
+    out_rows[:d] = rows[src]
+    out_valid = np.zeros(cfg.out_sub * C, dtype=bool)
+    out_valid[:d] = True
+
+    return BlockPlan(
+        sub_idx=sub_idx, hub_sel=hub_sel, route=route, flags=flags,
+        eroute=eroute, out_rows=out_rows, out_valid=out_valid, n_edges=e,
+    )
+
+
+def _plan_fold_block(in_rows, in_valid, cfg: PackConfig, out_sub: int,
+                     final_by_row: bool):
+    """Plan one fold block: inputs are `in_rows`/`in_valid` for the
+    concatenated slots of its (<= sub*C) input stream; the route sorts
+    valid slots by (row, original position), scan folds them, and
+    extraction emits one slot per distinct row (or slot==row when
+    `final_by_row`)."""
+    sub = cfg.sub
+    n = len(in_rows)
+    assert n <= sub * C
+    val = np.nonzero(in_valid)[0]
+    order = val[np.argsort(in_rows[val], kind="stable")]
+    e = len(order)
+    route = plan_route(order, np.arange(e, dtype=np.int64), sub, sub)
+
+    rows_sorted = in_rows[order]
+    flags = np.zeros((sub, C), dtype=np.int8)
+    csr_r, csr_l = np.arange(e) // C, np.arange(e) % C
+    seg_start = np.ones(e, dtype=bool)
+    seg_start[1:] = rows_sorted[1:] != rows_sorted[:-1]
+    flags[csr_r, csr_l] = 1 | (seg_start.astype(np.int8) << 1)
+
+    last = np.ones(e, dtype=bool)
+    last[:-1] = rows_sorted[1:] != rows_sorted[:-1]
+    src = np.nonzero(last)[0]
+    d = len(src)
+    if final_by_row:
+        dst = rows_sorted[src]
+        assert d == len(np.unique(dst))
+        out_rows = np.arange(out_sub * C, dtype=np.int64)
+        out_valid = np.zeros(out_sub * C, dtype=bool)
+        out_valid[dst] = True
+    else:
+        assert d <= out_sub * C
+        dst = np.arange(d, dtype=np.int64)
+        out_rows = np.zeros(out_sub * C, dtype=np.int64)
+        out_rows[:d] = rows_sorted[src]
+        out_valid = np.zeros(out_sub * C, dtype=bool)
+        out_valid[:d] = True
+    eroute = plan_route(src, dst, sub, out_sub)
+    return BlockPlan(
+        sub_idx=None, hub_sel=None, route=route, flags=flags,
+        eroute=eroute, out_rows=out_rows, out_valid=out_valid, n_edges=e,
+    )
+
+
+def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
+              n_cols: int, cfg: PackConfig = PackConfig()) -> PackPlan:
+    """Build the full static plan for `y[r] = sum_e x[col[e]]` over
+    CSR-sorted edges with `vp` output rows and `n_cols` x entries.
+
+    `vp` must be a multiple of 128 and fit one final block
+    (vp <= 8192*128 per plan; shard larger graphs)."""
+    edge_row = np.asarray(edge_row, dtype=np.int64)
+    edge_col = np.asarray(edge_col, dtype=np.int64)
+    assert vp % C == 0
+    if vp // C > 8192:
+        raise ValueError(
+            f"vp={vp} exceeds one final block (8192*128); shard the graph"
+        )
+    assert (np.diff(edge_row) >= 0).all(), "edges must be row-sorted"
+
+    # hub columns: the most-referenced ones (these overflow per-lane
+    # capacity in the packed layout; they read a register table instead)
+    counts = np.bincount(edge_col, minlength=n_cols)
+    hub = min(cfg.hub, n_cols)
+    hub_cols = np.argsort(-counts, kind="stable")[:hub].astype(np.int64)
+    hub_lut = np.full(n_cols, -1, dtype=np.int32)
+    hub_lut[hub_cols] = np.arange(hub, dtype=np.int32)
+    hub_cols_padded = np.zeros(cfg.hub, dtype=np.int64)
+    hub_cols_padded[:hub] = hub_cols
+
+    hub_idx_all = hub_lut[edge_col]
+    is_hub_all = hub_idx_all >= 0
+
+    plan = PackPlan(vp=vp, n_cols=n_cols, cfg=cfg,
+                    hub_cols=hub_cols_padded)
+
+    # one gather level per pass over the column space
+    span = cfg.sub * C
+    n_pass = max(1, -(-n_cols // span))
+    for p in range(n_pass):
+        base = p * span
+        # hub edges join the pass of their column so every edge lives
+        # in exactly one pass (their table entry is ignored anyway)
+        if n_pass > 1:
+            in_pass = (edge_col >= base) & (edge_col < base + span)
+        else:
+            in_pass = np.ones(len(edge_col), dtype=bool)
+        sel = np.nonzero(in_pass)[0]
+        if len(sel) == 0:
+            continue
+        rows, cols = edge_row[sel], edge_col[sel]
+        hub_idx = hub_idx_all[sel]
+        cuts = _cut_blocks(rows, cols - base, hub_idx >= 0, cfg)
+        # block planning is route-heavy numpy (argsort-dominated, GIL
+        # -friendly): thread it
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor() as pool:
+            blocks = list(pool.map(
+                lambda lohi: _plan_gather_block(
+                    rows[lohi[0]:lohi[1]], cols[lohi[0]:lohi[1]],
+                    hub_idx[lohi[0]:lohi[1]], base, cfg,
+                ),
+                cuts,
+            ))
+        plan.levels.append(LevelPlan(
+            cfg=cfg, blocks=blocks, has_gather=True, pass_base=base,
+            out_sub=cfg.out_sub,
+        ))
+
+    # fold levels: group the current streams until one block remains
+    def _streams(levels):
+        out = []
+        for lv in levels:
+            for b in lv.blocks:
+                out.append((b.out_rows, b.out_valid))
+        return out
+
+    streams = _streams(plan.levels)
+    group_cap = cfg.sub // cfg.out_sub
+    vp_sub = vp // C
+    depth = 0
+    # mid folds: contract while they help (already-compact streams,
+    # e.g. degree-1 tails, cannot contract — the multi-block final
+    # level absorbs them instead, having no distinct-rows limit)
+    while sum(len(r) for r, _ in streams) > cfg.slots:
+        blocks = []
+        nxt = []
+        i = 0
+        while i < len(streams):
+            grp = []
+            slots = 0
+            distinct = set()
+            while (i < len(streams) and len(grp) < group_cap
+                   and slots + len(streams[i][0]) <= cfg.slots):
+                r, v = streams[i]
+                u = set(np.unique(r[v]).tolist())
+                if grp and len(distinct | u) > cfg.max_distinct:
+                    break
+                distinct |= u
+                grp.append((r, v))
+                slots += len(r)
+                i += 1
+            in_rows = np.concatenate([r for r, _ in grp])
+            in_valid = np.concatenate([v for _, v in grp])
+            pad = cfg.slots - len(in_rows)
+            if pad:
+                in_rows = np.concatenate(
+                    [in_rows, np.zeros(pad, np.int64)]
+                )
+                in_valid = np.concatenate([in_valid, np.zeros(pad, bool)])
+            blk = _plan_fold_block(in_rows, in_valid, cfg, cfg.out_sub,
+                                   final_by_row=False)
+            blk.n_inputs = len(grp)
+            blocks.append(blk)
+            nxt.append((blk.out_rows, blk.out_valid))
+        if len(nxt) >= len(streams):
+            break  # no contraction possible; hand over to the final level
+        plan.levels.append(LevelPlan(cfg=cfg, blocks=blocks,
+                                     has_gather=False,
+                                     out_sub=cfg.out_sub))
+        streams = nxt
+        depth += 1
+        assert depth < 8, "fold recursion failed to converge"
+
+    # final level: multi-block, each block extracts straight into the
+    # dense [vp] layout (slot == row id); block outputs are summed by
+    # the caller, so overlapping rows across final blocks are fine
+    fblocks = []
+    i = 0
+    while i < len(streams):
+        grp = []
+        slots = 0
+        while i < len(streams) and slots + len(streams[i][0]) <= cfg.slots:
+            grp.append(streams[i])
+            slots += len(streams[i][0])
+            i += 1
+        if not grp:  # single stream larger than a block cannot happen
+            raise AssertionError("stream exceeds block capacity")
+        in_rows = np.concatenate([r for r, _ in grp])
+        in_valid = np.concatenate([v for _, v in grp])
+        pad = cfg.slots - len(in_rows)
+        if pad:
+            in_rows = np.concatenate([in_rows, np.zeros(pad, np.int64)])
+            in_valid = np.concatenate([in_valid, np.zeros(pad, bool)])
+        blk = _plan_fold_block(in_rows, in_valid, cfg, vp_sub,
+                               final_by_row=True)
+        blk.n_inputs = len(grp)
+        fblocks.append(blk)
+    plan.final = LevelPlan(cfg=cfg, blocks=fblocks, has_gather=False,
+                           out_sub=vp_sub)
+    return plan
+
+
+# --------------------------------------------------------------------------
+# numpy reference executor (the kernel's semantics, stage for stage)
+# --------------------------------------------------------------------------
+
+
+def _scan_np(v, f):
+    """Segmented inclusive sum over flattened [sub, C] row-major order
+    via shift-add stages — mirrors the kernel exactly."""
+    sub = v.shape[0]
+    n = sub * C
+    vf = v.reshape(n).copy()
+    ff = f.reshape(n).copy().astype(bool)
+    s = 1
+    while s < n:
+        add = np.where(ff[s:], 0.0, vf[:-s])
+        vf[s:] = vf[s:] + add
+        ff[s:] = ff[s:] | ff[:-s]
+        s *= 2
+    return vf.reshape(sub, C)
+
+
+def _exec_block_np(plan: PackPlan, lv: LevelPlan, blk: BlockPlan, x,
+                   x_hub, in_vals):
+    from libgrape_lite_tpu.ops.route3 import apply_route3_np
+
+    cfg = lv.cfg
+    if lv.has_gather:
+        tab = np.zeros((cfg.sub, C), dtype=x.dtype)
+        src = x[lv.pass_base: lv.pass_base + cfg.slots]
+        tab.reshape(-1)[: len(src)] = src
+        # lane-mix shuffle: tab_mixed[r, l] = tab[r, l ^ mix(r)]
+        rr = np.arange(cfg.sub)[:, None]
+        ll = np.arange(C)[None, :]
+        tab = np.take_along_axis(
+            tab, (ll ^ _row_mix(rr)).astype(np.int64), axis=1
+        )
+        v_tab = np.take_along_axis(
+            tab, blk.sub_idx.astype(np.int64), axis=0
+        )
+        hub_tab = x_hub.reshape(cfg.hub // C, C)
+        hs = blk.hub_sel.astype(np.int64)
+        hs_c = np.maximum(hs, 0)
+        v_hub = hub_tab[hs_c >> 7, hs_c & (C - 1)]
+        vals = np.where(hs >= 0, v_hub, v_tab)
+    else:
+        vals = in_vals
+    # route to row-sorted order
+    routed = apply_route3_np(vals.astype(np.float64), blk.route)
+    valid = (blk.flags & 1).astype(bool)
+    segst = ((blk.flags >> 1) & 1).astype(np.float64)
+    routed = np.where(valid, routed, 0.0)
+    f0 = np.where(valid, segst, 1.0)
+    cs = _scan_np(routed, f0)
+    out = apply_route3_np(cs, blk.eroute)
+    ovalid = blk.out_valid.reshape(lv.out_sub, C)
+    return np.where(ovalid, out, 0.0)
+
+
+def exec_plan_np(plan: PackPlan, x: np.ndarray) -> np.ndarray:
+    """Numpy reference of the whole pipeline."""
+    x_hub = x[plan.hub_cols]
+    streams = []
+    lvls = list(plan.levels)
+    gather_levels = [lv for lv in lvls if lv.has_gather]
+    fold_levels = [lv for lv in lvls if not lv.has_gather]
+    for lv in gather_levels:
+        for blk in lv.blocks:
+            streams.append(
+                _exec_block_np(plan, lv, blk, x, x_hub, None).reshape(-1)
+            )
+    for lv in fold_levels:
+        nxt = []
+        i = 0
+        for blk in lv.blocks:
+            k = blk.n_inputs
+            vals = np.concatenate(streams[i:i + k])
+            i += k
+            pad = lv.cfg.slots - len(vals)
+            if pad:
+                vals = np.concatenate([vals, np.zeros(pad)])
+            nxt.append(
+                _exec_block_np(
+                    plan, lv, blk, None, None,
+                    vals.reshape(lv.cfg.sub, C),
+                ).reshape(-1)
+            )
+        streams = nxt
+    y = np.zeros(plan.vp, dtype=np.float64)
+    i = 0
+    for blk in plan.final.blocks:
+        k = blk.n_inputs
+        vals = np.concatenate(streams[i:i + k])
+        i += k
+        pad = plan.cfg.slots - len(vals)
+        if pad:
+            vals = np.concatenate([vals, np.zeros(pad)])
+        out = _exec_block_np(plan, plan.final, blk, None, None,
+                             vals.reshape(plan.cfg.sub, C))
+        y += out.reshape(-1)[: plan.vp]
+    return y
+
+
+# --------------------------------------------------------------------------
+# device executor (Pallas TPU kernels; interpret mode off-TPU)
+# --------------------------------------------------------------------------
+
+
+def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
+                 n_stages: int):
+    """Build the kernel function for one level (shapes static)."""
+    import jax
+    import jax.numpy as jnp
+
+    def scan_segmented(v, f):
+        s = 1
+        for _ in range(n_stages):
+            if s < C:
+                rolled_v = jnp.roll(v, s, axis=1)
+                rolled_f = jnp.roll(f, s, axis=1)
+                prev_v = jnp.concatenate(
+                    [jnp.zeros((1, C), v.dtype), rolled_v[:-1]], axis=0
+                )
+                prev_f = jnp.concatenate(
+                    [jnp.ones((1, C), f.dtype), rolled_f[:-1]], axis=0
+                )
+                lane = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 1)
+                sh_v = jnp.where(lane < s, prev_v, rolled_v)
+                sh_f = jnp.where(lane < s, prev_f, rolled_f)
+            else:
+                k = s // C
+                sh_v = jnp.concatenate(
+                    [jnp.zeros((k, C), v.dtype), v[:-k]], axis=0
+                )
+                sh_f = jnp.concatenate(
+                    [jnp.ones((k, C), f.dtype), f[:-k]], axis=0
+                )
+            v = v + jnp.where(f > 0, jnp.zeros_like(v), sh_v)
+            f = jnp.maximum(f, sh_f)
+            s *= 2
+        return v
+
+    from libgrape_lite_tpu.ops.route3 import apply_route3
+
+    def tail(vals, l1_ref, s2_ref, l3_ref, flags_ref,
+             el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
+        """Shared route -> segmented scan -> extraction epilogue."""
+        flags = flags_ref[0]
+        routed = apply_route3(vals, l1_ref[0], s2_ref[0], l3_ref[0])
+        valid = (flags & 1) > 0
+        segst = ((flags >> 1) & 1).astype(vals.dtype)
+        routed = jnp.where(valid, routed, jnp.zeros_like(routed))
+        f0 = jnp.where(valid, segst, jnp.ones_like(segst))
+        cs = scan_segmented(routed, f0)
+        ex = apply_route3(cs, el1_ref[0], es2_ref[0], el3_ref[0])
+        out_ref[0] = jnp.where(eval_ref[0] > 0, ex, jnp.zeros_like(ex))
+
+    if lv_has_gather:
+        def kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
+                   l1_ref, s2_ref, l3_ref, flags_ref,
+                   el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
+            tab = tab_ref[...]
+            # undo the lane mix: tab_mixed[r, l] = tab[r, l ^ mix(r)]
+            rr = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 0)
+            ll = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 1)
+            mix = (rr ^ (rr >> 7)) & (C - 1)
+            tab = jnp.take_along_axis(tab, ll ^ mix, axis=1)
+            v_tab = jnp.take_along_axis(tab, sub_idx_ref[0], axis=0)
+            hs = hub_sel_ref[0]
+            hs_c = jnp.maximum(hs, 0)
+            hub_hi = hs_c >> 7
+            hub_lo = hs_c & (C - 1)
+            v_hub = jnp.zeros((sub, C), tab.dtype)
+            for k in range(hub // C):
+                tk = jnp.broadcast_to(hubtab_ref[k:k + 1], (sub, C))
+                gk = jnp.take_along_axis(tk, hub_lo, axis=1)
+                v_hub = jnp.where(hub_hi == k, gk, v_hub)
+            vals = jnp.where(hs >= 0, v_hub, v_tab)
+            tail(vals, l1_ref, s2_ref, l3_ref, flags_ref,
+                 el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
+    else:
+        def kernel(vals_ref, l1_ref, s2_ref, l3_ref, flags_ref,
+                   el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
+            tail(vals_ref[0], l1_ref, s2_ref, l3_ref, flags_ref,
+                 el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
+
+    return kernel
+
+
+def _stack_blocks(lv: LevelPlan):
+    """Stack a level's static block arrays into device-ready numpy."""
+    import numpy as np
+
+    def st(get, dtype):
+        return np.stack([get(b).astype(dtype) for b in lv.blocks])
+
+    d = {
+        "l1": st(lambda b: b.route.l1, np.int32),
+        "s2": st(lambda b: b.route.s2, np.int32),
+        "l3": st(lambda b: b.route.l3, np.int32),
+        "flags": st(lambda b: b.flags, np.int32),
+        "el1": st(lambda b: b.eroute.l1, np.int32),
+        "es2": st(lambda b: b.eroute.s2, np.int32),
+        "el3": st(lambda b: b.eroute.l3, np.int32),
+        "eval": st(
+            lambda b: b.out_valid.reshape(lv.out_sub, C), np.int32
+        ),
+    }
+    if lv.has_gather:
+        d["sub_idx"] = st(lambda b: b.sub_idx, np.int32)
+        d["hub_sel"] = st(lambda b: b.hub_sel, np.int32)
+    return d
+
+
+def _level_device(plan: PackPlan, key, lv: LevelPlan):
+    import jax.numpy as jnp
+
+    if key not in plan._device:
+        plan._device[key] = {
+            k: jnp.asarray(v) for k, v in _stack_blocks(lv).items()
+        }
+    return plan._device[key]
+
+
+def _run_level(plan: PackPlan, key, lv: LevelPlan, x_tab, hub_tab,
+               in_streams, interpret: bool):
+    """Run one level's pallas_call; returns list of per-block flat
+    output streams (traced jnp arrays)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    cfg = lv.cfg
+    nb = len(lv.blocks)
+    sub, out_sub = cfg.sub, lv.out_sub
+    n_stages = max(1, int(np.ceil(np.log2(sub * C))))
+    dev = _level_device(plan, key, lv)
+    kernel = _kernel_body(lv.has_gather, sub, out_sub, cfg.hub, n_stages)
+
+    def bspec(shape_sub):
+        return pl.BlockSpec((1, shape_sub, C), lambda i: (i, 0, 0))
+
+    common_in = [
+        dev["l1"], dev["s2"], dev["l3"], dev["flags"],
+        dev["el1"], dev["es2"], dev["el3"], dev["eval"],
+    ]
+    rmid = lv.blocks[0].route.s2.shape[0]
+    ermid = lv.blocks[0].eroute.s2.shape[0]
+    common_specs = [
+        bspec(rmid), bspec(rmid), bspec(sub), bspec(sub),
+        bspec(ermid), bspec(ermid), bspec(out_sub), bspec(out_sub),
+    ]
+
+    if lv.has_gather:
+        args = [x_tab, hub_tab, dev["sub_idx"], dev["hub_sel"]] + common_in
+        specs = [
+            pl.BlockSpec((sub, C), lambda i: (0, 0)),
+            pl.BlockSpec((cfg.hub // C, C), lambda i: (0, 0)),
+            bspec(sub), bspec(sub),
+        ] + common_specs
+    else:
+        # assemble the ragged fold inputs into a uniform [nb, sub, C]
+        # (all offsets static; these are plain XLA concats/reshapes)
+        parts = []
+        off = 0
+        for b in lv.blocks:
+            k = b.n_inputs
+            segs = in_streams[off:off + k]
+            ln = sum(s.shape[0] for s in segs)
+            pad = cfg.slots - ln
+            if pad:
+                segs = segs + [jnp.zeros((pad,), segs[0].dtype)]
+            parts.append(jnp.concatenate(segs).reshape(sub, C))
+            off += k
+        args = [jnp.stack(parts)] + common_in
+        specs = [bspec(sub)] + common_specs
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=specs,
+        out_specs=bspec(out_sub),
+        out_shape=jax.ShapeDtypeStruct((nb, out_sub, C), jnp.float32),
+        interpret=interpret,
+    )(*args)
+    return [out[b].reshape(-1) for b in range(nb)]
+
+
+def segment_sum_pack(x, plan: PackPlan, interpret: bool | None = None):
+    """Run the full pack-gather segment-sum pipeline: y[vp] f32.
+
+    Usable inside jit; all static structure is closed over as device
+    constants.  `interpret=None` auto-selects compiled-on-TPU.
+    """
+    import jax.numpy as jnp
+
+    if interpret is None:
+        from libgrape_lite_tpu.ops.pallas_kernels import use_pallas
+
+        interpret = not use_pallas()
+
+    cfg = plan.cfg
+    x = jnp.asarray(x, jnp.float32)
+    span = cfg.slots
+    n_pass = max(1, -(-plan.n_cols // span))
+    x_pad = jnp.concatenate(
+        [x, jnp.zeros((n_pass * span - plan.n_cols,), x.dtype)]
+    ) if n_pass * span != plan.n_cols else x
+    x_passes = x_pad.reshape(n_pass, cfg.sub, C)
+    hub_tab = x[jnp.asarray(plan.hub_cols)].reshape(cfg.hub // C, C)
+
+    if not plan.final or not plan.final.blocks:
+        # zero-edge plan: nothing to gather or fold
+        return jnp.zeros((plan.vp,), jnp.float32)
+
+    streams = []
+    for li, lv in enumerate(plan.levels):
+        if not lv.has_gather:
+            continue
+        p = lv.pass_base // span
+        streams += _run_level(plan, ("g", li), lv, x_passes[p], hub_tab,
+                              None, interpret)
+    for li, lv in enumerate(plan.levels):
+        if lv.has_gather:
+            continue
+        streams = _run_level(plan, ("f", li), lv, None, None, streams,
+                             interpret)
+    outs = _run_level(plan, ("final",), plan.final, None, None, streams,
+                      interpret)
+    y = outs[0]
+    for o in outs[1:]:
+        y = y + o
+    return y[: plan.vp]
+
+
+# --------------------------------------------------------------------------
+# fragment-level entry point
+# --------------------------------------------------------------------------
+
+_FRAG_PLAN_CACHE = None
+
+
+def plan_pack_for_fragment(frag, cfg: PackConfig = PackConfig()):
+    """Build (and cache per fragment) the pack plan for `frag`'s
+    in-edge pull: rows = local edge_src, cols = pid edge_nbr into the
+    gathered [fnum*vp] state.  Single-shard fragments only for now —
+    multi-shard needs uniform per-shard plan shapes under shard_map
+    (planned; the message path already covers multi-shard pulls)."""
+    global _FRAG_PLAN_CACHE
+    import weakref
+
+    if frag.fnum != 1:
+        return None
+    if _FRAG_PLAN_CACHE is None:
+        _FRAG_PLAN_CACHE = weakref.WeakKeyDictionary()
+    per_frag = _FRAG_PLAN_CACHE.setdefault(frag, {})
+    if cfg in per_frag:
+        return per_frag[cfg]
+    h = frag.host_ie[0] if frag.host_ie else frag.host_oe[0]
+    mask = h.edge_mask
+    rows = h.edge_src[mask].astype(np.int64)
+    cols = h.edge_nbr[mask].astype(np.int64)
+    plan = plan_pack(rows, cols, frag.vp, frag.fnum * frag.vp, cfg)
+    per_frag[cfg] = plan
+    return plan

@@ -1,0 +1,110 @@
+"""Offline TPU-lowering regression for every shipped Pallas kernel.
+
+Round 1 shipped a kernel whose block shapes violated Mosaic's (8, 128)
+rule — interpret-mode tests passed, and the failure only surfaced on
+real hardware (docs/PERF_NOTES.md).  Mosaic lowering runs client-side,
+so `.trace(...).lower(lowering_platforms=('tpu',))` validates kernels
+with no TPU attached.  The check runs in a subprocess with the axon
+plugin disabled (its backend init hangs when the tunnel is down and it
+registers via sitecustomize regardless of JAX_PLATFORMS).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCRIPT = r"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import sys
+sys.path.insert(0, %(repo)r)
+
+from libgrape_lite_tpu.ops.spmv_pack import (
+    PackConfig, plan_pack, segment_sum_pack,
+)
+
+# production geometry, one gather block + fold/final levels
+cfg = PackConfig(sub=4096, out_sub=512, hub=1024)
+rng = np.random.default_rng(0)
+vp = 8192 * 128            # 2^20 rows: the bench shard size
+e = 200_000
+rows = np.sort(rng.integers(0, vp, e))
+cols = rng.integers(0, vp, e)
+plan = plan_pack(rows, cols, vp, vp, cfg)
+
+x = jax.ShapeDtypeStruct((vp,), jnp.float32)
+traced = jax.jit(
+    lambda x: segment_sum_pack(x, plan, interpret=False)
+).trace(x)
+low = traced.lower(lowering_platforms=('tpu',))
+print("SPMV_PACK_LOWERED", len(low.as_text()))
+"""
+
+
+def test_spmv_pack_lowers_for_tpu():
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c", SCRIPT % {"repo": REPO}],
+        capture_output=True, text=True, timeout=850, env=env,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-4000:]
+    assert "SPMV_PACK_LOWERED" in r.stdout
+
+
+SCRIPT2 = r"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import sys
+sys.path.insert(0, %(repo)r)
+
+# strict-tile SpMV at bench-like shapes
+from libgrape_lite_tpu.ops.spmv import plan_tiles, spmv_strict
+
+rng = np.random.default_rng(0)
+vp = 1 << 18
+src = np.sort(rng.integers(0, vp, 1 << 20)).astype(np.int32)
+row_lo, rmax, num_tiles = plan_tiles(src, 2048, vp)
+vals = jax.ShapeDtypeStruct((len(src),), jnp.float32)
+srcs = jax.ShapeDtypeStruct((len(src),), jnp.int32)
+low = jax.jit(
+    lambda v, s: spmv_strict(v, s, row_lo, vp, 2048, rmax,
+                             interpret=False)
+).trace(vals, srcs).lower(lowering_platforms=('tpu',))
+print("SPMV_STRICT_LOWERED", len(low.as_text()))
+
+# LCC bitmap intersect kernel (both aligned and full-dim word counts)
+from libgrape_lite_tpu.ops.pallas_kernels import intersect_count
+
+for words in (128, 197):
+    a = jax.ShapeDtypeStruct((4096, words), jnp.uint32)
+    low = jax.jit(
+        lambda a: intersect_count(a, a, block=512, interpret=False)
+    ).trace(a).lower(lowering_platforms=('tpu',))
+    print(f"INTERSECT_LOWERED_{words}", len(low.as_text()))
+"""
+
+
+def test_legacy_kernels_lower_for_tpu():
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c", SCRIPT2 % {"repo": REPO}],
+        capture_output=True, text=True, timeout=850, env=env,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-4000:]
+    assert "SPMV_STRICT_LOWERED" in r.stdout
+    assert "INTERSECT_LOWERED_128" in r.stdout
+    assert "INTERSECT_LOWERED_197" in r.stdout
