@@ -30,13 +30,39 @@ class SSSP(ParallelAppBase):
     needs_edata = True  # double edata (run_app.cc:48-52)
 
     def init_state(self, frag, source=0):
+        import os
+
+        import jax
+
         dtype = frag.host_ie[0].edge_w.dtype if frag.weighted else np.float32
+        if not jax.config.jax_enable_x64:
+            # honest TPU dtype: x64-off would downcast silently anyway
+            dtype = np.float32
         dist = np.full((frag.fnum, frag.vp), np.inf, dtype=dtype)
         from libgrape_lite_tpu.app.base import resolve_source
 
         pid = resolve_source(frag, source, "SSSP")
         if pid >= 0:
             dist[pid // frag.vp, pid % frag.vp] = 0.0
+        # tropical pack pipeline (ops/spmv_pack.py, GRAPE_SPMV=pack):
+        # min-relaxation with the f32 weight stream baked into the plan
+        self._pack_plan = None
+        if (
+            os.environ.get("GRAPE_SPMV") == "pack"
+            and np.dtype(dtype) == np.float32
+            and frag.fnum == 1
+            and frag.weighted
+        ):
+            from libgrape_lite_tpu.ops.spmv_pack import (
+                plan_pack_for_fragment,
+            )
+
+            self._pack_plan = plan_pack_for_fragment(
+                frag, with_weights=True
+            )
+        self._pack_plan_uid = (
+            self._pack_plan.uid if self._pack_plan is not None else -1
+        )
         return {"dist": dist}
 
     def peval(self, ctx: StepContext, frag, state):
@@ -48,9 +74,18 @@ class SSSP(ParallelAppBase):
         dist = state["dist"]
         ie = frag.ie
         full = ctx.gather_state(dist)
-        inf = jnp.asarray(jnp.inf, dist.dtype)
-        cand = jnp.where(ie.edge_mask, full[ie.edge_nbr] + ie.edge_w, inf)
-        relaxed = self.segment_reduce(cand, ie.edge_src, frag.vp, "min")
+        if self._pack_plan is not None:
+            from libgrape_lite_tpu.ops.spmv_pack import (
+                segment_reduce_pack,
+            )
+
+            relaxed = segment_reduce_pack(full, self._pack_plan, "min")
+        else:
+            inf = jnp.asarray(jnp.inf, dist.dtype)
+            cand = jnp.where(
+                ie.edge_mask, full[ie.edge_nbr] + ie.edge_w, inf
+            )
+            relaxed = self.segment_reduce(cand, ie.edge_src, frag.vp, "min")
         new = jnp.minimum(dist, relaxed)
         changed = jnp.logical_and(new < dist, frag.inner_mask)
         active = ctx.sum(changed.sum().astype(jnp.int32))

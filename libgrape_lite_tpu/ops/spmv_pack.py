@@ -102,6 +102,7 @@ class BlockPlan:
     out_valid: np.ndarray          # [out_slots] bool
     n_edges: int = 0
     n_inputs: int = 1              # fold levels: streams concatenated
+    w: Optional[np.ndarray] = None  # [sub, C] f32 edge weights, CSR order
 
 
 @dataclass
@@ -181,11 +182,14 @@ def _cut_blocks(rows, local_cols, hub_mask, cfg: PackConfig):
     return cuts
 
 
-def _plan_gather_block(rows, cols, hub_idx, base, cfg: PackConfig):
+def _plan_gather_block(rows, cols, hub_idx, base, cfg: PackConfig,
+                       w=None):
     """Plan one gather block from its CSR-ordered edge slice.
 
     hub_idx: int32 per edge, -1 if the edge reads the pass table,
     else its index into the hub table.  `base` is the pass's x offset.
+    `w`: optional per-edge weights (same slice), stored in CSR slot
+    order for post-route application.
     """
     e = len(rows)
     sub = cfg.sub
@@ -245,9 +249,15 @@ def _plan_gather_block(rows, cols, hub_idx, base, cfg: PackConfig):
     out_valid = np.zeros(cfg.out_sub * C, dtype=bool)
     out_valid[:d] = True
 
+    w_block = None
+    if w is not None:
+        w_block = np.zeros((sub, C), dtype=np.float32)
+        w_block[csr_r, csr_l] = w.astype(np.float32)
+
     return BlockPlan(
         sub_idx=sub_idx, hub_sel=hub_sel, route=route, flags=flags,
         eroute=eroute, out_rows=out_rows, out_valid=out_valid, n_edges=e,
+        w=w_block,
     )
 
 
@@ -298,7 +308,8 @@ def _plan_fold_block(in_rows, in_valid, cfg: PackConfig, out_sub: int,
 
 
 def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
-              n_cols: int, cfg: PackConfig = PackConfig()) -> PackPlan:
+              n_cols: int, cfg: PackConfig = PackConfig(),
+              edge_w: np.ndarray | None = None) -> PackPlan:
     """Build the full static plan for `y[r] = sum_e x[col[e]]` over
     CSR-sorted edges with `vp` output rows and `n_cols` x entries.
 
@@ -330,8 +341,11 @@ def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
                     hub_cols=hub_cols_padded)
 
     # one gather level per pass over the column space
+    from concurrent.futures import ThreadPoolExecutor
+
     span = cfg.sub * C
     n_pass = max(1, -(-n_cols // span))
+    pool = ThreadPoolExecutor()
     for p in range(n_pass):
         base = p * span
         # hub edges join the pass of their column so every edge lives
@@ -345,23 +359,25 @@ def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
             continue
         rows, cols = edge_row[sel], edge_col[sel]
         hub_idx = hub_idx_all[sel]
+        w_sel = edge_w[sel] if edge_w is not None else None
         cuts = _cut_blocks(rows, cols - base, hub_idx >= 0, cfg)
         # block planning is route-heavy numpy (argsort-dominated, GIL
         # -friendly): thread it
-        from concurrent.futures import ThreadPoolExecutor
-
-        with ThreadPoolExecutor() as pool:
-            blocks = list(pool.map(
-                lambda lohi: _plan_gather_block(
-                    rows[lohi[0]:lohi[1]], cols[lohi[0]:lohi[1]],
-                    hub_idx[lohi[0]:lohi[1]], base, cfg,
-                ),
-                cuts,
-            ))
+        blocks = list(pool.map(
+            lambda lohi, rows=rows, cols=cols, hub_idx=hub_idx,
+                   w_sel=w_sel, base=base: _plan_gather_block(
+                rows[lohi[0]:lohi[1]], cols[lohi[0]:lohi[1]],
+                hub_idx[lohi[0]:lohi[1]], base, cfg,
+                w_sel[lohi[0]:lohi[1]] if w_sel is not None else None,
+            ),
+            cuts,
+        ))
         plan.levels.append(LevelPlan(
             cfg=cfg, blocks=blocks, has_gather=True, pass_base=base,
             out_sub=cfg.out_sub,
         ))
+
+    pool.shutdown()
 
     # fold levels: group the current streams until one block remains
     def _streams(levels):
@@ -452,26 +468,50 @@ def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
 # --------------------------------------------------------------------------
 
 
-def _scan_np(v, f):
-    """Segmented inclusive sum over flattened [sub, C] row-major order
-    via shift-add stages — mirrors the kernel exactly."""
+# reduction semirings: (combine, identity, weight-combine).  `min`/`max`
+# pair with ADDITIVE edge weights (the tropical semiring SSSP/BFS
+# relaxation x[nbr] + w); `sum` pairs with multiplicative weights.
+_KINDS = {
+    "sum": (np.add, 0.0, np.multiply),
+    "min": (np.minimum, np.inf, np.add),
+    "max": (np.maximum, -np.inf, np.add),
+}
+
+
+def _jnp_kind(kind):
+    """The jnp (combine, identity, weight-combine) triple, mirroring
+    _KINDS so the kernel and numpy reference cannot drift."""
+    import jax.numpy as jnp
+
+    return {
+        "sum": (jnp.add, 0.0, jnp.multiply),
+        "min": (jnp.minimum, np.inf, jnp.add),
+        "max": (jnp.maximum, -np.inf, jnp.add),
+    }[kind]
+
+
+def _scan_np(v, f, kind):
+    """Segmented inclusive scan over flattened [sub, C] row-major order
+    via shift-combine stages — mirrors the kernel exactly."""
+    op, ident, _ = _KINDS[kind]
     sub = v.shape[0]
     n = sub * C
     vf = v.reshape(n).copy()
     ff = f.reshape(n).copy().astype(bool)
     s = 1
     while s < n:
-        add = np.where(ff[s:], 0.0, vf[:-s])
-        vf[s:] = vf[s:] + add
+        carry = np.where(ff[s:], ident, vf[:-s])
+        vf[s:] = op(vf[s:], carry)
         ff[s:] = ff[s:] | ff[:-s]
         s *= 2
     return vf.reshape(sub, C)
 
 
 def _exec_block_np(plan: PackPlan, lv: LevelPlan, blk: BlockPlan, x,
-                   x_hub, in_vals):
+                   x_hub, in_vals, kind="sum"):
     from libgrape_lite_tpu.ops.route3 import apply_route3_np
 
+    op, ident, wop = _KINDS[kind]
     cfg = lv.cfg
     if lv.has_gather:
         tab = np.zeros((cfg.sub, C), dtype=x.dtype)
@@ -495,18 +535,21 @@ def _exec_block_np(plan: PackPlan, lv: LevelPlan, blk: BlockPlan, x,
         vals = in_vals
     # route to row-sorted order
     routed = apply_route3_np(vals.astype(np.float64), blk.route)
+    if lv.has_gather and blk.w is not None:
+        routed = wop(routed, blk.w.astype(np.float64))
     valid = (blk.flags & 1).astype(bool)
     segst = ((blk.flags >> 1) & 1).astype(np.float64)
-    routed = np.where(valid, routed, 0.0)
+    routed = np.where(valid, routed, ident)
     f0 = np.where(valid, segst, 1.0)
-    cs = _scan_np(routed, f0)
+    cs = _scan_np(routed, f0, kind)
     out = apply_route3_np(cs, blk.eroute)
     ovalid = blk.out_valid.reshape(lv.out_sub, C)
-    return np.where(ovalid, out, 0.0)
+    return np.where(ovalid, out, ident)
 
 
-def exec_plan_np(plan: PackPlan, x: np.ndarray) -> np.ndarray:
+def exec_plan_np(plan: PackPlan, x: np.ndarray, kind="sum") -> np.ndarray:
     """Numpy reference of the whole pipeline."""
+    op, ident, _ = _KINDS[kind]
     x_hub = x[plan.hub_cols]
     streams = []
     lvls = list(plan.levels)
@@ -515,7 +558,8 @@ def exec_plan_np(plan: PackPlan, x: np.ndarray) -> np.ndarray:
     for lv in gather_levels:
         for blk in lv.blocks:
             streams.append(
-                _exec_block_np(plan, lv, blk, x, x_hub, None).reshape(-1)
+                _exec_block_np(plan, lv, blk, x, x_hub, None,
+                               kind).reshape(-1)
             )
     for lv in fold_levels:
         nxt = []
@@ -526,15 +570,15 @@ def exec_plan_np(plan: PackPlan, x: np.ndarray) -> np.ndarray:
             i += k
             pad = lv.cfg.slots - len(vals)
             if pad:
-                vals = np.concatenate([vals, np.zeros(pad)])
+                vals = np.concatenate([vals, np.full(pad, ident)])
             nxt.append(
                 _exec_block_np(
                     plan, lv, blk, None, None,
-                    vals.reshape(lv.cfg.sub, C),
+                    vals.reshape(lv.cfg.sub, C), kind,
                 ).reshape(-1)
             )
         streams = nxt
-    y = np.zeros(plan.vp, dtype=np.float64)
+    y = np.full(plan.vp, ident, dtype=np.float64)
     i = 0
     for blk in plan.final.blocks:
         k = blk.n_inputs
@@ -542,10 +586,10 @@ def exec_plan_np(plan: PackPlan, x: np.ndarray) -> np.ndarray:
         i += k
         pad = plan.cfg.slots - len(vals)
         if pad:
-            vals = np.concatenate([vals, np.zeros(pad)])
+            vals = np.concatenate([vals, np.full(pad, ident)])
         out = _exec_block_np(plan, plan.final, blk, None, None,
-                             vals.reshape(plan.cfg.sub, C))
-        y += out.reshape(-1)[: plan.vp]
+                             vals.reshape(plan.cfg.sub, C), kind)
+        y = op(y, out.reshape(-1)[: plan.vp])
     return y
 
 
@@ -555,10 +599,12 @@ def exec_plan_np(plan: PackPlan, x: np.ndarray) -> np.ndarray:
 
 
 def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
-                 n_stages: int):
+                 n_stages: int, kind: str = "sum", has_w: bool = False):
     """Build the kernel function for one level (shapes static)."""
     import jax
     import jax.numpy as jnp
+
+    op, ident, wop = _jnp_kind(kind)
 
     def scan_segmented(v, f):
         s = 1
@@ -567,7 +613,8 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
                 rolled_v = jnp.roll(v, s, axis=1)
                 rolled_f = jnp.roll(f, s, axis=1)
                 prev_v = jnp.concatenate(
-                    [jnp.zeros((1, C), v.dtype), rolled_v[:-1]], axis=0
+                    [jnp.full((1, C), ident, v.dtype), rolled_v[:-1]],
+                    axis=0,
                 )
                 prev_f = jnp.concatenate(
                     [jnp.ones((1, C), f.dtype), rolled_f[:-1]], axis=0
@@ -578,41 +625,42 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
             else:
                 k = s // C
                 sh_v = jnp.concatenate(
-                    [jnp.zeros((k, C), v.dtype), v[:-k]], axis=0
+                    [jnp.full((k, C), ident, v.dtype), v[:-k]], axis=0
                 )
                 sh_f = jnp.concatenate(
                     [jnp.ones((k, C), f.dtype), f[:-k]], axis=0
                 )
-            v = v + jnp.where(f > 0, jnp.zeros_like(v), sh_v)
+            v = op(v, jnp.where(f > 0, jnp.full_like(v, ident), sh_v))
             f = jnp.maximum(f, sh_f)
             s *= 2
         return v
 
     from libgrape_lite_tpu.ops.route3 import apply_route3
 
-    def tail(vals, l1_ref, s2_ref, l3_ref, flags_ref,
+    def tail(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
              el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
         """Shared route -> segmented scan -> extraction epilogue."""
         flags = flags_ref[0]
         routed = apply_route3(vals, l1_ref[0], s2_ref[0], l3_ref[0])
+        if w_ref is not None:
+            routed = wop(routed, w_ref[0])
         valid = (flags & 1) > 0
         segst = ((flags >> 1) & 1).astype(vals.dtype)
-        routed = jnp.where(valid, routed, jnp.zeros_like(routed))
+        routed = jnp.where(valid, routed, jnp.full_like(routed, ident))
         f0 = jnp.where(valid, segst, jnp.ones_like(segst))
         cs = scan_segmented(routed, f0)
         ex = apply_route3(cs, el1_ref[0], es2_ref[0], el3_ref[0])
-        out_ref[0] = jnp.where(eval_ref[0] > 0, ex, jnp.zeros_like(ex))
+        out_ref[0] = jnp.where(eval_ref[0] > 0, ex,
+                               jnp.full_like(ex, ident))
 
-    if lv_has_gather:
-        def kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
-                   l1_ref, s2_ref, l3_ref, flags_ref,
-                   el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
+    def _gather_kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
+                       w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
+                       el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
             tab = tab_ref[...]
             # undo the lane mix: tab_mixed[r, l] = tab[r, l ^ mix(r)]
             rr = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 0)
             ll = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 1)
-            mix = (rr ^ (rr >> 7)) & (C - 1)
-            tab = jnp.take_along_axis(tab, ll ^ mix, axis=1)
+            tab = jnp.take_along_axis(tab, ll ^ _row_mix(rr), axis=1)
             v_tab = jnp.take_along_axis(tab, sub_idx_ref[0], axis=0)
             hs = hub_sel_ref[0]
             hs_c = jnp.maximum(hs, 0)
@@ -624,12 +672,27 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
                 gk = jnp.take_along_axis(tk, hub_lo, axis=1)
                 v_hub = jnp.where(hub_hi == k, gk, v_hub)
             vals = jnp.where(hs >= 0, v_hub, v_tab)
-            tail(vals, l1_ref, s2_ref, l3_ref, flags_ref,
+            tail(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
                  el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
+
+    if lv_has_gather and has_w:
+        def kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
+                   w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
+                   el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
+            _gather_kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
+                           w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
+                           el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
+    elif lv_has_gather:
+        def kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
+                   l1_ref, s2_ref, l3_ref, flags_ref,
+                   el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
+            _gather_kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
+                           None, l1_ref, s2_ref, l3_ref, flags_ref,
+                           el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
     else:
         def kernel(vals_ref, l1_ref, s2_ref, l3_ref, flags_ref,
                    el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
-            tail(vals_ref[0], l1_ref, s2_ref, l3_ref, flags_ref,
+            tail(vals_ref[0], None, l1_ref, s2_ref, l3_ref, flags_ref,
                  el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
 
     return kernel
@@ -657,6 +720,8 @@ def _stack_blocks(lv: LevelPlan):
     if lv.has_gather:
         d["sub_idx"] = st(lambda b: b.sub_idx, np.int32)
         d["hub_sel"] = st(lambda b: b.hub_sel, np.int32)
+        if lv.blocks[0].w is not None:
+            d["w"] = st(lambda b: b.w, np.float32)
     return d
 
 
@@ -671,7 +736,7 @@ def _level_device(plan: PackPlan, key, lv: LevelPlan):
 
 
 def _run_level(plan: PackPlan, key, lv: LevelPlan, x_tab, hub_tab,
-               in_streams, interpret: bool):
+               in_streams, interpret: bool, kind: str = "sum"):
     """Run one level's pallas_call; returns list of per-block flat
     output streams (traced jnp arrays)."""
     import jax
@@ -683,7 +748,9 @@ def _run_level(plan: PackPlan, key, lv: LevelPlan, x_tab, hub_tab,
     sub, out_sub = cfg.sub, lv.out_sub
     n_stages = max(1, int(np.ceil(np.log2(sub * C))))
     dev = _level_device(plan, key, lv)
-    kernel = _kernel_body(lv.has_gather, sub, out_sub, cfg.hub, n_stages)
+    has_w = lv.has_gather and "w" in dev
+    kernel = _kernel_body(lv.has_gather, sub, out_sub, cfg.hub, n_stages,
+                          kind, has_w)
 
     def bspec(shape_sub):
         return pl.BlockSpec((1, shape_sub, C), lambda i: (i, 0, 0))
@@ -700,12 +767,17 @@ def _run_level(plan: PackPlan, key, lv: LevelPlan, x_tab, hub_tab,
     ]
 
     if lv.has_gather:
-        args = [x_tab, hub_tab, dev["sub_idx"], dev["hub_sel"]] + common_in
+        args = [x_tab, hub_tab, dev["sub_idx"], dev["hub_sel"]]
         specs = [
             pl.BlockSpec((sub, C), lambda i: (0, 0)),
             pl.BlockSpec((cfg.hub // C, C), lambda i: (0, 0)),
             bspec(sub), bspec(sub),
-        ] + common_specs
+        ]
+        if has_w:
+            args.append(dev["w"])
+            specs.append(bspec(sub))
+        args += common_in
+        specs += common_specs
     else:
         # assemble the ragged fold inputs into a uniform [nb, sub, C]
         # (all offsets static; these are plain XLA concats/reshapes)
@@ -717,7 +789,10 @@ def _run_level(plan: PackPlan, key, lv: LevelPlan, x_tab, hub_tab,
             ln = sum(s.shape[0] for s in segs)
             pad = cfg.slots - ln
             if pad:
-                segs = segs + [jnp.zeros((pad,), segs[0].dtype)]
+                ident = _KINDS[kind][1]
+                segs = segs + [
+                    jnp.full((pad,), ident, segs[0].dtype)
+                ]
             parts.append(jnp.concatenate(segs).reshape(sub, C))
             off += k
         args = [jnp.stack(parts)] + common_in
@@ -734,8 +809,14 @@ def _run_level(plan: PackPlan, key, lv: LevelPlan, x_tab, hub_tab,
     return [out[b].reshape(-1) for b in range(nb)]
 
 
-def segment_sum_pack(x, plan: PackPlan, interpret: bool | None = None):
-    """Run the full pack-gather segment-sum pipeline: y[vp] f32.
+def segment_reduce_pack(x, plan: PackPlan, kind: str = "sum",
+                        interpret: bool | None = None):
+    """Run the full pack-gather segment-reduce pipeline: y[vp] f32.
+
+    kind selects the semiring: "sum" (weights multiply — classic
+    SpMV), "min"/"max" (weights add — the tropical relaxation of
+    SSSP/BFS; rows with no edges yield the identity, matching
+    jax.ops.segment_min).  One plan serves every kind.
 
     Usable inside jit; all static structure is closed over as device
     constants.  `interpret=None` auto-selects compiled-on-TPU.
@@ -759,7 +840,7 @@ def segment_sum_pack(x, plan: PackPlan, interpret: bool | None = None):
 
     if not plan.final or not plan.final.blocks:
         # zero-edge plan: nothing to gather or fold
-        return jnp.zeros((plan.vp,), jnp.float32)
+        return jnp.full((plan.vp,), _KINDS[kind][1], jnp.float32)
 
     streams = []
     for li, lv in enumerate(plan.levels):
@@ -767,18 +848,24 @@ def segment_sum_pack(x, plan: PackPlan, interpret: bool | None = None):
             continue
         p = lv.pass_base // span
         streams += _run_level(plan, ("g", li), lv, x_passes[p], hub_tab,
-                              None, interpret)
+                              None, interpret, kind)
     for li, lv in enumerate(plan.levels):
         if lv.has_gather:
             continue
         streams = _run_level(plan, ("f", li), lv, None, None, streams,
-                             interpret)
+                             interpret, kind)
     outs = _run_level(plan, ("final",), plan.final, None, None, streams,
-                      interpret)
+                      interpret, kind)
+    op, _, _ = _jnp_kind(kind)
     y = outs[0]
     for o in outs[1:]:
-        y = y + o
+        y = op(y, o)
     return y[: plan.vp]
+
+
+def segment_sum_pack(x, plan: PackPlan, interpret: bool | None = None):
+    """Back-compat alias: segment_reduce_pack(kind="sum")."""
+    return segment_reduce_pack(x, plan, "sum", interpret)
 
 
 # --------------------------------------------------------------------------
@@ -788,12 +875,15 @@ def segment_sum_pack(x, plan: PackPlan, interpret: bool | None = None):
 _FRAG_PLAN_CACHE = None
 
 
-def plan_pack_for_fragment(frag, cfg: PackConfig = PackConfig()):
+def plan_pack_for_fragment(frag, cfg: PackConfig = PackConfig(),
+                           with_weights: bool = False):
     """Build (and cache per fragment) the pack plan for `frag`'s
     in-edge pull: rows = local edge_src, cols = pid edge_nbr into the
-    gathered [fnum*vp] state.  Single-shard fragments only for now —
-    multi-shard needs uniform per-shard plan shapes under shard_map
-    (planned; the message path already covers multi-shard pulls)."""
+    gathered [fnum*vp] state; `with_weights` bakes the f32 edge-weight
+    stream in (the tropical SSSP relaxation).  Single-shard fragments
+    only for now — multi-shard needs uniform per-shard plan shapes
+    under shard_map (planned; the message path already covers
+    multi-shard pulls)."""
     global _FRAG_PLAN_CACHE
     import weakref
 
@@ -802,12 +892,19 @@ def plan_pack_for_fragment(frag, cfg: PackConfig = PackConfig()):
     if _FRAG_PLAN_CACHE is None:
         _FRAG_PLAN_CACHE = weakref.WeakKeyDictionary()
     per_frag = _FRAG_PLAN_CACHE.setdefault(frag, {})
-    if cfg in per_frag:
-        return per_frag[cfg]
+    key = (cfg, with_weights)
+    if key in per_frag:
+        return per_frag[key]
     h = frag.host_ie[0] if frag.host_ie else frag.host_oe[0]
     mask = h.edge_mask
     rows = h.edge_src[mask].astype(np.int64)
     cols = h.edge_nbr[mask].astype(np.int64)
-    plan = plan_pack(rows, cols, frag.vp, frag.fnum * frag.vp, cfg)
-    per_frag[cfg] = plan
+    w = None
+    if with_weights:
+        if h.edge_w is None:
+            return None
+        w = h.edge_w[mask]
+    plan = plan_pack(rows, cols, frag.vp, frag.fnum * frag.vp, cfg,
+                     edge_w=w)
+    per_frag[key] = plan
     return plan

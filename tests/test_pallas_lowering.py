@@ -46,6 +46,15 @@ traced = jax.jit(
 ).trace(x)
 low = traced.lower(lowering_platforms=('tpu',))
 print("SPMV_PACK_LOWERED", len(low.as_text()))
+
+# tropical min with baked weight stream (the SSSP relaxation)
+from libgrape_lite_tpu.ops.spmv_pack import segment_reduce_pack
+w = rng.uniform(0.1, 5.0, e).astype(np.float32)
+plan_w = plan_pack(rows, cols, vp, vp, cfg, edge_w=w)
+low = jax.jit(
+    lambda x: segment_reduce_pack(x, plan_w, "min", interpret=False)
+).trace(x).lower(lowering_platforms=('tpu',))
+print("SPMV_PACK_MIN_LOWERED", len(low.as_text()))
 """
 
 
@@ -59,6 +68,7 @@ def test_spmv_pack_lowers_for_tpu():
     )
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-4000:]
     assert "SPMV_PACK_LOWERED" in r.stdout
+    assert "SPMV_PACK_MIN_LOWERED" in r.stdout
 
 
 SCRIPT2 = r"""

@@ -276,3 +276,121 @@ def test_pagerank_pack_end_to_end(monkeypatch):
     assert app._pack_plan is not None, "pack plan not engaged"
     got = w.result_values()
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-7)
+
+
+# --------------------------------------------------------------------------
+# semiring kinds: min/max with additive weights (tropical relaxation)
+# --------------------------------------------------------------------------
+
+
+def _reference_kind(rows, cols, x, vp, kind, w=None):
+    ident = {"sum": 0.0, "min": np.inf, "max": -np.inf}[kind]
+    y = np.full(vp, ident, dtype=np.float64)
+    vals = x[cols].astype(np.float64)
+    if w is not None:
+        vals = vals * w if kind == "sum" else vals + w
+    ufunc = {"sum": np.add, "min": np.minimum, "max": np.maximum}[kind]
+    ufunc.at(y, rows, vals)
+    return y
+
+
+@pytest.mark.parametrize("kind", ["min", "max"])
+def test_kind_reference(kind):
+    rng = np.random.default_rng(31)
+    e, vp = 6000, 1024
+    rows = np.sort(rng.integers(0, vp, e))
+    cols = rng.integers(0, vp, e)
+    # the plan stores weights f32; the reference must round identically
+    w = rng.uniform(0.1, 5.0, e).astype(np.float32).astype(np.float64)
+    x = rng.normal(size=vp)
+    plan = plan_pack(rows, cols, vp, vp, TINY, edge_w=w)
+    got = exec_plan_np(plan, x, kind)
+    want = _reference_kind(rows, cols, x, vp, kind, w)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+
+def test_sum_with_multiplicative_weights():
+    rng = np.random.default_rng(32)
+    e, vp = 5000, 512
+    rows = np.sort(rng.integers(0, vp, e))
+    cols = rng.integers(0, vp, e)
+    w = rng.uniform(0.1, 2.0, e).astype(np.float32).astype(np.float64)
+    x = rng.normal(size=vp)
+    plan = plan_pack(rows, cols, vp, vp, TINY, edge_w=w)
+    got = exec_plan_np(plan, x, "sum")
+    want = _reference_kind(rows, cols, x, vp, "sum", w)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+
+def test_jnp_min_tropical_sssp_like():
+    """One SSSP relaxation: dist'[r] = min over in-edges of
+    dist[nbr] + w — the tropical pipeline vs jax segment_min."""
+    import jax.numpy as jnp
+
+    from libgrape_lite_tpu.ops.spmv_pack import segment_reduce_pack
+
+    rng = np.random.default_rng(33)
+    e, vp = 8000, 1024
+    rows = np.sort(rng.integers(0, vp, e))
+    cols = rng.integers(0, vp, e)
+    w = rng.uniform(0.1, 9.0, e).astype(np.float32)
+    dist = rng.uniform(0, 50, vp).astype(np.float32)
+    dist[rng.integers(0, vp, 100)] = np.inf  # unreached vertices
+    plan = plan_pack(rows, cols, vp, vp, TINY, edge_w=w)
+    got = np.asarray(segment_reduce_pack(
+        jnp.asarray(dist), plan, "min", interpret=True
+    ))
+    want = _reference_kind(rows, cols, dist.astype(np.float64), vp,
+                           "min", w.astype(np.float64))
+    finite = np.isfinite(want)
+    np.testing.assert_allclose(got[finite], want[finite], rtol=1e-5)
+    assert np.isinf(got[~finite]).all()
+
+
+def test_sssp_pack_end_to_end(monkeypatch):
+    """SSSP through the tropical pack pipeline (fnum=1, f32 weights)
+    must match the XLA min path exactly (min is order-independent)."""
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.partitioner import MapPartitioner
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    rng = np.random.default_rng(41)
+    n, e = 600, 5000
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    w = rng.uniform(0.5, 4.0, e).astype(np.float32)
+    oids = np.arange(n, dtype=np.int64)
+    comm = CommSpec(fnum=1)
+    vm = VertexMap.build(oids, MapPartitioner(1, oids))
+    frag = ShardedEdgecutFragment.build(
+        comm, vm, src, dst, w, directed=False,
+        load_strategy=LoadStrategy.kBothOutIn,
+    )
+
+    monkeypatch.delenv("GRAPE_SPMV", raising=False)
+    w_ref = Worker(SSSP(), frag)
+    w_ref.query(source=0)
+    ref = w_ref.result_values()
+
+    import libgrape_lite_tpu.ops.spmv_pack as sp
+
+    monkeypatch.setenv("GRAPE_SPMV", "pack")
+    orig = sp.plan_pack_for_fragment
+
+    def small_cfg(frag, cfg=None, with_weights=False):
+        return orig(frag, PackConfig(sub=16, out_sub=8, hub=128),
+                    with_weights=with_weights)
+
+    monkeypatch.setattr(sp, "plan_pack_for_fragment", small_cfg)
+    app = SSSP()
+    wk = Worker(app, frag)
+    wk.query(source=0)
+    assert app._pack_plan is not None, "pack plan not engaged"
+    got = wk.result_values()
+    finite = np.isfinite(ref)
+    np.testing.assert_allclose(got[finite], ref[finite], rtol=1e-6)
+    assert np.isinf(got[~finite]).all()
