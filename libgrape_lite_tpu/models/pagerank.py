@@ -77,16 +77,23 @@ class PageRank(BatchShuffleAppBase):
 
         self._spmv_mode = os.environ.get("GRAPE_SPMV", "auto")
         self._pack_plan = None
-        if (
-            self._spmv_mode == "pack"
-            and self.dtype == np.float32
-            and frag.fnum == 1
-        ):
+        if self._spmv_mode == "pack":
             from libgrape_lite_tpu.ops.spmv_pack import (
                 plan_pack_for_fragment,
+                warn_pack_ineligible,
             )
 
-            self._pack_plan = plan_pack_for_fragment(frag)
+            if self.dtype != np.float32:
+                warn_pack_ineligible(
+                    "PageRank", f"state dtype {self.dtype} is not float32"
+                )
+            else:
+                self._pack_plan = plan_pack_for_fragment(frag)
+                if self._pack_plan is None:
+                    warn_pack_ineligible(
+                        "PageRank",
+                        "plan_pack_for_fragment returned no plan",
+                    )
         # bake the plan identity into the trace key: a cached runner
         # must never pair with a different fragment's closed-over plan
         self._pack_plan_uid = (

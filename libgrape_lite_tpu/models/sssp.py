@@ -47,19 +47,28 @@ class SSSP(ParallelAppBase):
         # tropical pack pipeline (ops/spmv_pack.py, GRAPE_SPMV=pack):
         # min-relaxation with the f32 weight stream baked into the plan
         self._pack_plan = None
-        if (
-            os.environ.get("GRAPE_SPMV") == "pack"
-            and np.dtype(dtype) == np.float32
-            and frag.fnum == 1
-            and frag.weighted
-        ):
+        if os.environ.get("GRAPE_SPMV") == "pack":
             from libgrape_lite_tpu.ops.spmv_pack import (
                 plan_pack_for_fragment,
+                warn_pack_ineligible,
             )
 
-            self._pack_plan = plan_pack_for_fragment(
-                frag, with_weights=True
-            )
+            if np.dtype(dtype) != np.float32:
+                warn_pack_ineligible(
+                    "SSSP", f"state dtype {np.dtype(dtype)} is not float32"
+                )
+            elif not frag.weighted:
+                warn_pack_ineligible(
+                    "SSSP", "fragment has no edge weights"
+                )
+            else:
+                self._pack_plan = plan_pack_for_fragment(
+                    frag, with_weights=True
+                )
+                if self._pack_plan is None:
+                    warn_pack_ineligible(
+                        "SSSP", "plan_pack_for_fragment returned no plan"
+                    )
         self._pack_plan_uid = (
             self._pack_plan.uid if self._pack_plan is not None else -1
         )

@@ -227,8 +227,9 @@ def apply_route3_np(x: np.ndarray, rt: Route3) -> np.ndarray:
 def apply_route3(x, l1, s2, l3):
     """Kernel-side application with jnp ops (usable in Pallas TPU
     kernels and in interpret mode).  `x` [r_src, c] is zero-padded to
-    the middle height; index arrays are the Route3 fields (dense int32
-    blocks).  Returns [r_dst, c] — mask with Route3.valid."""
+    the middle height; index arrays are the Route3 fields (narrow int
+    blocks, upcast to int32 here — they ship int8/int16 to halve VMEM).
+    Returns [r_dst, c] — mask with Route3.valid."""
     import jax.numpy as jnp
 
     r_mid, c = s2.shape
@@ -237,7 +238,7 @@ def apply_route3(x, l1, s2, l3):
         x = jnp.concatenate(
             [x, jnp.zeros((r_mid - r_src, c), x.dtype)], axis=0
         )
-    s1 = jnp.take_along_axis(x, l1, axis=1)
-    s2v = jnp.take_along_axis(s1, s2, axis=0)
+    s1 = jnp.take_along_axis(x, l1.astype(jnp.int32), axis=1)
+    s2v = jnp.take_along_axis(s1, s2.astype(jnp.int32), axis=0)
     r_dst = l3.shape[0]
-    return jnp.take_along_axis(s2v[:r_dst], l3, axis=1)
+    return jnp.take_along_axis(s2v[:r_dst], l3.astype(jnp.int32), axis=1)

@@ -73,9 +73,29 @@ def _row_mix(r):
 
 @dataclass(frozen=True)
 class PackConfig:
-    sub: int = 4096        # sublane rows per block (block = sub*128 slots)
+    # sub=2048 keeps the worst gather-level VMEM residency (streams
+    # double-buffered + x-table + f32 temps) within the ~16 MB/core
+    # budget of v5e — see vmem_bytes(); sub=4096 overflows it
+    sub: int = 2048        # sublane rows per block (block = sub*128 slots)
     out_sub: int = 512     # sublane rows per compact output block
     hub: int = 1024        # hub table size (multiple of 128)
+
+    def __post_init__(self):
+        # sub/hub index streams are int16 and hub rows split into
+        # [hub/128, 128] register tiles — enforce the ranges the device
+        # dtypes silently assume (ADVICE r2: a sub > 32767 would wrap
+        # on astype(int16) with no error)
+        if not (0 < self.sub <= 32767):
+            raise ValueError(f"sub={self.sub} not in (0, 32767]")
+        if not (0 < self.hub <= 32767) or self.hub % C:
+            raise ValueError(
+                f"hub={self.hub} must be a positive multiple of {C} "
+                "<= 32767"
+            )
+        if not (0 < self.out_sub <= self.sub):
+            raise ValueError(
+                f"out_sub={self.out_sub} not in (0, sub={self.sub}]"
+            )
 
     @property
     def slots(self) -> int:
@@ -84,6 +104,34 @@ class PackConfig:
     @property
     def max_distinct(self) -> int:
         return self.out_sub * C
+
+    def vmem_bytes(self, has_gather: bool, has_w: bool,
+                   out_sub: int | None = None) -> int:
+        """Worst-case VMEM residency estimate for one level's kernel:
+        grid-varying streams are double-buffered by the Pallas
+        pipeline (x2); grid-invariant tables buffer once; plus the f32
+        working set (routed block, scan value+flag planes, one int32
+        upcast of an index stream at a time).  An estimate, not a
+        Mosaic quote — plan_pack warns when it exceeds
+        GRAPE_PACK_VMEM_BUDGET (default 14 MiB)."""
+        o = self.out_sub if out_sub is None else out_sub
+        ermid = max(self.sub, o)
+        varying = (
+            self.sub * C * (1 + 2 + 1)       # l1 i8, s2 i16, l3 i8
+            + self.sub * C * 1               # flags i8
+            + ermid * C * (1 + 2)            # el1 i8, es2 i16
+            + o * C * (1 + 1)                # el3 i8, eval i8
+            + o * C * 4                      # out f32
+        )
+        if has_gather:
+            varying += self.sub * C * (2 + 2)  # sub_idx i16, hub_sel i16
+            if has_w:
+                varying += self.sub * C * 4    # w f32
+        else:
+            varying += self.sub * C * 4        # fold input vals f32
+        invariant = (self.sub * C + self.hub) * 4 if has_gather else 0
+        temps = (self.sub * C * 4) * 3 + ermid * C * 4
+        return 2 * varying + invariant + temps
 
 
 @dataclass
@@ -345,39 +393,40 @@ def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
 
     span = cfg.sub * C
     n_pass = max(1, -(-n_cols // span))
-    pool = ThreadPoolExecutor()
-    for p in range(n_pass):
-        base = p * span
-        # hub edges join the pass of their column so every edge lives
-        # in exactly one pass (their table entry is ignored anyway)
-        if n_pass > 1:
-            in_pass = (edge_col >= base) & (edge_col < base + span)
-        else:
-            in_pass = np.ones(len(edge_col), dtype=bool)
-        sel = np.nonzero(in_pass)[0]
-        if len(sel) == 0:
-            continue
-        rows, cols = edge_row[sel], edge_col[sel]
-        hub_idx = hub_idx_all[sel]
-        w_sel = edge_w[sel] if edge_w is not None else None
-        cuts = _cut_blocks(rows, cols - base, hub_idx >= 0, cfg)
-        # block planning is route-heavy numpy (argsort-dominated, GIL
-        # -friendly): thread it
-        blocks = list(pool.map(
-            lambda lohi, rows=rows, cols=cols, hub_idx=hub_idx,
-                   w_sel=w_sel, base=base: _plan_gather_block(
-                rows[lohi[0]:lohi[1]], cols[lohi[0]:lohi[1]],
-                hub_idx[lohi[0]:lohi[1]], base, cfg,
-                w_sel[lohi[0]:lohi[1]] if w_sel is not None else None,
-            ),
-            cuts,
-        ))
-        plan.levels.append(LevelPlan(
-            cfg=cfg, blocks=blocks, has_gather=True, pass_base=base,
-            out_sub=cfg.out_sub,
-        ))
-
-    pool.shutdown()
+    # `with` guarantees worker threads are reaped even when block
+    # planning raises (ADVICE r2: the bare shutdown leaked them)
+    with ThreadPoolExecutor() as pool:
+        for p in range(n_pass):
+            base = p * span
+            # hub edges join the pass of their column so every edge
+            # lives in exactly one pass (their table entry is ignored
+            # anyway)
+            if n_pass > 1:
+                in_pass = (edge_col >= base) & (edge_col < base + span)
+            else:
+                in_pass = np.ones(len(edge_col), dtype=bool)
+            sel = np.nonzero(in_pass)[0]
+            if len(sel) == 0:
+                continue
+            rows, cols = edge_row[sel], edge_col[sel]
+            hub_idx = hub_idx_all[sel]
+            w_sel = edge_w[sel] if edge_w is not None else None
+            cuts = _cut_blocks(rows, cols - base, hub_idx >= 0, cfg)
+            # block planning is route-heavy numpy (argsort-dominated,
+            # GIL-friendly): thread it
+            blocks = list(pool.map(
+                lambda lohi, rows=rows, cols=cols, hub_idx=hub_idx,
+                       w_sel=w_sel, base=base: _plan_gather_block(
+                    rows[lohi[0]:lohi[1]], cols[lohi[0]:lohi[1]],
+                    hub_idx[lohi[0]:lohi[1]], base, cfg,
+                    w_sel[lohi[0]:lohi[1]] if w_sel is not None else None,
+                ),
+                cuts,
+            ))
+            plan.levels.append(LevelPlan(
+                cfg=cfg, blocks=blocks, has_gather=True, pass_base=base,
+                out_sub=cfg.out_sub,
+            ))
 
     # fold levels: group the current streams until one block remains
     def _streams(levels):
@@ -460,7 +509,38 @@ def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
         fblocks.append(blk)
     plan.final = LevelPlan(cfg=cfg, blocks=fblocks, has_gather=False,
                            out_sub=vp_sub)
+    _warn_vmem(cfg, has_w=edge_w is not None, final_out_sub=vp_sub)
     return plan
+
+
+def _warn_vmem(cfg: PackConfig, has_w: bool, final_out_sub: int = 0):
+    """Warn once per (cfg, shape class) when the estimated per-kernel
+    VMEM residency exceeds the budget (GRAPE_PACK_VMEM_BUDGET bytes,
+    default 14 MiB of the ~16 MiB/core on v5e)."""
+    import os
+    import warnings
+
+    budget = int(os.environ.get("GRAPE_PACK_VMEM_BUDGET", 14 << 20))
+    worst = max(
+        cfg.vmem_bytes(has_gather=True, has_w=has_w),
+        cfg.vmem_bytes(has_gather=False, has_w=False,
+                       out_sub=final_out_sub or cfg.out_sub),
+    )
+    if worst > budget:
+        key = (cfg.sub, cfg.out_sub, cfg.hub, has_w, final_out_sub)
+        if key not in _VMEM_WARNED:
+            _VMEM_WARNED.add(key)
+            warnings.warn(
+                f"pack plan estimated VMEM {worst / 2**20:.1f} MiB exceeds "
+                f"budget {budget / 2**20:.1f} MiB (sub={cfg.sub}, "
+                f"final_out_sub={final_out_sub}); the kernel may fail "
+                "Mosaic VMEM allocation — shrink PackConfig.sub or shard "
+                "the graph",
+                stacklevel=3,
+            )
+
+
+_VMEM_WARNED: set = set()
 
 
 # --------------------------------------------------------------------------
@@ -640,7 +720,7 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
     def tail(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
              el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
         """Shared route -> segmented scan -> extraction epilogue."""
-        flags = flags_ref[0]
+        flags = flags_ref[0].astype(jnp.int32)
         routed = apply_route3(vals, l1_ref[0], s2_ref[0], l3_ref[0])
         if w_ref is not None:
             routed = wop(routed, w_ref[0])
@@ -661,8 +741,10 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
             rr = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 0)
             ll = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 1)
             tab = jnp.take_along_axis(tab, ll ^ _row_mix(rr), axis=1)
-            v_tab = jnp.take_along_axis(tab, sub_idx_ref[0], axis=0)
-            hs = hub_sel_ref[0]
+            v_tab = jnp.take_along_axis(
+                tab, sub_idx_ref[0].astype(jnp.int32), axis=0
+            )
+            hs = hub_sel_ref[0].astype(jnp.int32)
             hs_c = jnp.maximum(hs, 0)
             hub_hi = hs_c >> 7
             hub_lo = hs_c & (C - 1)
@@ -699,27 +781,42 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
 
 
 def _stack_blocks(lv: LevelPlan):
-    """Stack a level's static block arrays into device-ready numpy."""
+    """Stack a level's static block arrays into device-ready numpy.
+
+    Index streams stay narrow on device (lane ids int8, row ids int16 —
+    ADVICE r2: int32 streams double the VMEM bill for nothing); the
+    kernel upcasts to int32 at each use site.  Lane ids are < 128 and
+    block row ids < 32768 by PackConfig validation; a stream whose
+    values outgrow the narrow dtype (the final level's es2 scales with
+    vp//128) widens to int32 instead of wrapping."""
     import numpy as np
 
     def st(get, dtype):
-        return np.stack([get(b).astype(dtype) for b in lv.blocks])
+        out = np.stack([get(b) for b in lv.blocks])
+        if np.issubdtype(dtype, np.integer):
+            # widen rather than wrap when a stream outgrows its narrow
+            # dtype (the final level's es2 rows scale with vp//128,
+            # which PackConfig cannot bound)
+            info = np.iinfo(dtype)
+            if out.min() < info.min or out.max() > info.max:
+                dtype = np.int32
+        return out.astype(dtype)
 
     d = {
-        "l1": st(lambda b: b.route.l1, np.int32),
-        "s2": st(lambda b: b.route.s2, np.int32),
-        "l3": st(lambda b: b.route.l3, np.int32),
-        "flags": st(lambda b: b.flags, np.int32),
-        "el1": st(lambda b: b.eroute.l1, np.int32),
-        "es2": st(lambda b: b.eroute.s2, np.int32),
-        "el3": st(lambda b: b.eroute.l3, np.int32),
+        "l1": st(lambda b: b.route.l1, np.int8),
+        "s2": st(lambda b: b.route.s2, np.int16),
+        "l3": st(lambda b: b.route.l3, np.int8),
+        "flags": st(lambda b: b.flags, np.int8),
+        "el1": st(lambda b: b.eroute.l1, np.int8),
+        "es2": st(lambda b: b.eroute.s2, np.int16),
+        "el3": st(lambda b: b.eroute.l3, np.int8),
         "eval": st(
-            lambda b: b.out_valid.reshape(lv.out_sub, C), np.int32
+            lambda b: b.out_valid.reshape(lv.out_sub, C), np.int8
         ),
     }
     if lv.has_gather:
-        d["sub_idx"] = st(lambda b: b.sub_idx, np.int32)
-        d["hub_sel"] = st(lambda b: b.hub_sel, np.int32)
+        d["sub_idx"] = st(lambda b: b.sub_idx, np.int16)
+        d["hub_sel"] = st(lambda b: b.hub_sel, np.int16)
         if lv.blocks[0].w is not None:
             d["w"] = st(lambda b: b.w, np.float32)
     return d
@@ -873,6 +970,30 @@ def segment_sum_pack(x, plan: PackPlan, interpret: bool | None = None):
 # --------------------------------------------------------------------------
 
 _FRAG_PLAN_CACHE = None
+_INELIGIBLE_WARNED: set = set()
+
+
+def warn_pack_ineligible(app_name: str, reason: str):
+    """GRAPE_SPMV=pack was requested but the app fell back to XLA —
+    say so once (ADVICE r2: a silent fallback lets an explicit pack
+    A/B quietly measure the wrong path).  GRAPE_SPMV_STRICT=1 turns
+    the fallback into an error for benchmark harnesses."""
+    import os
+    import warnings
+
+    key = (app_name, reason)
+    if os.environ.get("GRAPE_SPMV_STRICT"):
+        raise RuntimeError(
+            f"GRAPE_SPMV=pack requested but {app_name} is ineligible: "
+            f"{reason} (GRAPE_SPMV_STRICT=1)"
+        )
+    if key not in _INELIGIBLE_WARNED:
+        _INELIGIBLE_WARNED.add(key)
+        warnings.warn(
+            f"GRAPE_SPMV=pack requested but {app_name} falls back to the "
+            f"XLA path: {reason}",
+            stacklevel=3,
+        )
 
 
 def plan_pack_for_fragment(frag, cfg: PackConfig = PackConfig(),

@@ -31,8 +31,9 @@ from libgrape_lite_tpu.ops.spmv_pack import (
     PackConfig, plan_pack, segment_sum_pack,
 )
 
-# production geometry, one gather block + fold/final levels
-cfg = PackConfig(sub=4096, out_sub=512, hub=1024)
+# production geometry (the shipped default config): at vp = 2^20 the
+# column space spans 4 gather passes, plus fold/final levels
+cfg = PackConfig()
 rng = np.random.default_rng(0)
 vp = 8192 * 128            # 2^20 rows: the bench shard size
 e = 200_000
