@@ -60,6 +60,23 @@ class StepContext(Communicator):
     def fid():
         return lax.axis_index(FRAG_AXIS)
 
+    @staticmethod
+    def exchange_mirrors(x_local, send_idx):
+        """Mirror-compressed form of `gather_state` (reference
+        `batch_shuffle_message_manager.h:237-264`): exchange only the
+        outer-vertex rows each neighbor shard actually references.
+
+        x_local: this shard's [vp] state; send_idx: this shard's
+        [fnum, m] send table (rows ordered by receiver, from
+        `parallel/mirror.MirrorPlan`).  Returns the compact
+        [vp + fnum*m] table addressed by the plan's `nbr_compact`
+        columns — O(vp + mirrors) instead of O(fnum*vp)."""
+        vals = x_local[send_idx]
+        recv = lax.all_to_all(
+            vals, FRAG_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )
+        return jnp.concatenate([x_local, recv.reshape(-1)])
+
 
 def resolve_source(frag, source, app_name: str) -> int:
     """oid -> pid for a query source, logging when absent (shared by
@@ -96,6 +113,14 @@ class AppBase:
     # state keys that are mesh-replicated (everything else is sharded
     # with leading fragment dim)
     replicated_keys: FrozenSet[str] = frozenset()
+
+    # state keys that are read-only trace INPUTS, not loop state: they
+    # enter the jitted superstep sharded like normal leaves but are
+    # excluded from the while_loop carry and from the outputs (the
+    # pack pipeline's per-shard stream tables ride in this way —
+    # constants can't, because closing over an array under shard_map
+    # replicates it to every device)
+    ephemeral_keys: FrozenSet[str] = frozenset()
 
     # which mesh the superstep runs on: "frag" = the 1-D fragment axis
     # (default); "vc2d" = the k x k (vcrow, vccol) SUMMA mesh for

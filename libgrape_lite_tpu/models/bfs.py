@@ -27,13 +27,52 @@ class BFS(ParallelAppBase):
     result_format = "int"
 
     def init_state(self, frag, source=0):
+        import os
+
         depth = np.full((frag.fnum, frag.vp), _SENTINEL, dtype=np.int32)
         from libgrape_lite_tpu.app.base import resolve_source
 
         pid = resolve_source(frag, source, "BFS")
         if pid >= 0:
             depth[pid // frag.vp, pid % frag.vp] = 0
-        return {"depth": depth}
+        state = {"depth": depth}
+        eph_entries = {}
+        self._mx = None
+        if os.environ.get("GRAPE_EXCHANGE") == "mirror" and frag.fnum > 1:
+            from libgrape_lite_tpu.parallel.mirror import (
+                build_mirror_plan,
+            )
+
+            self._mx = build_mirror_plan(frag, "ie")
+            eph_entries.update(self._mx.state_entries("mx_"))
+        self._mx_uid = self._mx.uid if self._mx is not None else -1
+        # pack-gather min pull (GRAPE_SPMV=pack): unit-weight tropical
+        # relaxation — min(nbr)+1 == min(nbr+1), so the plan needs no
+        # weight stream; unreached vertices travel as +inf
+        self._pack = None
+        if os.environ.get("GRAPE_SPMV") == "pack":
+            from libgrape_lite_tpu.ops.spmv_pack import (
+                resolve_pack_dispatch,
+                warn_pack_ineligible,
+            )
+
+            if frag.fnum * frag.vp > (1 << 24):
+                warn_pack_ineligible(
+                    "BFS", "depth range exceeds exact f32 range (2^24)"
+                )
+            else:
+                self._pack = resolve_pack_dispatch(
+                    frag, direction="ie", mirror=self._mx
+                )
+                if self._pack is None:
+                    warn_pack_ineligible("BFS", "no pack plan buildable")
+                else:
+                    eph_entries.update(self._pack.state_entries())
+        if eph_entries:
+            state.update(eph_entries)
+            self.ephemeral_keys = frozenset(eph_entries)
+        self._pack_uid = self._pack.uid if self._pack is not None else -1
+        return state
 
     def peval(self, ctx: StepContext, frag, state):
         return state, jnp.int32(1)
@@ -42,12 +81,24 @@ class BFS(ParallelAppBase):
         depth = state["depth"]
         ie = frag.ie
         full = ctx.gather_state(depth)
-        nbr_d = full[ie.edge_nbr]
         sent = jnp.int32(_SENTINEL)
-        cand = jnp.where(
-            jnp.logical_and(ie.edge_mask, nbr_d != sent), nbr_d + 1, sent
-        )
-        relaxed = self.segment_reduce(cand, ie.edge_src, frag.vp, "min")
+        if self._pack is not None:
+            full_f = jnp.where(
+                full == sent, jnp.float32(jnp.inf),
+                full.astype(jnp.float32),
+            )
+            red = self._pack.reduce(full_f, state, "min") + 1.0
+            relaxed = jnp.where(
+                jnp.isfinite(red), red.astype(jnp.int32), sent
+            )
+        else:
+            nbr_d = full[ie.edge_nbr]
+            cand = jnp.where(
+                jnp.logical_and(ie.edge_mask, nbr_d != sent),
+                nbr_d + 1, sent,
+            )
+            relaxed = self.segment_reduce(cand, ie.edge_src, frag.vp,
+                                          "min")
         new = jnp.minimum(depth, relaxed)
         changed = jnp.logical_and(new < depth, frag.inner_mask)
         active = ctx.sum(changed.sum().astype(jnp.int32))

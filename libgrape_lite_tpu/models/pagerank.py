@@ -76,10 +76,22 @@ class PageRank(BatchShuffleAppBase):
         import os
 
         self._spmv_mode = os.environ.get("GRAPE_SPMV", "auto")
-        self._pack_plan = None
+        self._pack = None
+        eph_entries = {}
+        # mirror-compressed exchange (GRAPE_EXCHANGE=mirror): sync only
+        # outer-vertex rows instead of all_gathering the full state
+        self._mx = None
+        if os.environ.get("GRAPE_EXCHANGE") == "mirror" and frag.fnum > 1:
+            from libgrape_lite_tpu.parallel.mirror import (
+                build_mirror_plan,
+            )
+
+            self._mx = build_mirror_plan(frag, "ie")
+            eph_entries.update(self._mx.state_entries("mx_"))
+        self._mx_uid = self._mx.uid if self._mx is not None else -1
         if self._spmv_mode == "pack":
             from libgrape_lite_tpu.ops.spmv_pack import (
-                plan_pack_for_fragment,
+                resolve_pack_dispatch,
                 warn_pack_ineligible,
             )
 
@@ -88,18 +100,24 @@ class PageRank(BatchShuffleAppBase):
                     "PageRank", f"state dtype {self.dtype} is not float32"
                 )
             else:
-                self._pack_plan = plan_pack_for_fragment(frag)
-                if self._pack_plan is None:
+                # single-shard: stream tables close over the trace;
+                # multi-shard: they enter as sharded ephemeral state
+                self._pack = resolve_pack_dispatch(frag, mirror=self._mx)
+                if self._pack is None:
                     warn_pack_ineligible(
-                        "PageRank",
-                        "plan_pack_for_fragment returned no plan",
+                        "PageRank", "no pack plan buildable"
                     )
+                else:
+                    eph_entries.update(self._pack.state_entries())
+        if eph_entries:
+            state.update(eph_entries)
+            self.ephemeral_keys = frozenset(eph_entries)
         # bake the plan identity into the trace key: a cached runner
         # must never pair with a different fragment's closed-over plan
         self._pack_plan_uid = (
-            self._pack_plan.uid if self._pack_plan is not None else -1
+            self._pack.uid if self._pack is not None else -1
         )
-        if self._pack_plan is None:
+        if self._pack is None:
             from libgrape_lite_tpu.ops.spmv import plan_for_app
 
             plan = plan_for_app(frag, frag.vp, self.dtype)
@@ -172,16 +190,19 @@ class PageRank(BatchShuffleAppBase):
         rank = state["rank"]
         dt = rank.dtype
         ie = frag.ie
-        full = ctx.gather_state(rank)
-        if self._pack_plan is not None:
+        if self._mx is not None:
+            full = ctx.exchange_mirrors(rank, state["mx_send"])
+            nbr = state["mx_nbr"]
+        else:
+            full = ctx.gather_state(rank)
+            nbr = ie.edge_nbr
+        if self._pack is not None:
             # pack-gather pipeline: the plan owns BOTH the x[nbr]
             # gather and the row reduction (pad edges were excluded at
             # plan time, so no mask multiply is needed)
-            from libgrape_lite_tpu.ops.spmv_pack import segment_sum_pack
-
-            cur = segment_sum_pack(full, self._pack_plan).astype(dt)
+            cur = self._pack.reduce(full, state, "sum").astype(dt)
             return self.round_update(frag, state, cur)
-        contrib = jnp.where(ie.edge_mask, full[ie.edge_nbr], jnp.asarray(0, dt))
+        contrib = jnp.where(ie.edge_mask, full[nbr], jnp.asarray(0, dt))
         from libgrape_lite_tpu.ops.spmv import segment_sum_auto
 
         plan = (

@@ -46,10 +46,21 @@ class SSSP(ParallelAppBase):
             dist[pid // frag.vp, pid % frag.vp] = 0.0
         # tropical pack pipeline (ops/spmv_pack.py, GRAPE_SPMV=pack):
         # min-relaxation with the f32 weight stream baked into the plan
-        self._pack_plan = None
+        self._pack = None
+        state = {"dist": dist}
+        eph_entries = {}
+        self._mx = None
+        if os.environ.get("GRAPE_EXCHANGE") == "mirror" and frag.fnum > 1:
+            from libgrape_lite_tpu.parallel.mirror import (
+                build_mirror_plan,
+            )
+
+            self._mx = build_mirror_plan(frag, "ie")
+            eph_entries.update(self._mx.state_entries("mx_"))
+        self._mx_uid = self._mx.uid if self._mx is not None else -1
         if os.environ.get("GRAPE_SPMV") == "pack":
             from libgrape_lite_tpu.ops.spmv_pack import (
-                plan_pack_for_fragment,
+                resolve_pack_dispatch,
                 warn_pack_ineligible,
             )
 
@@ -62,17 +73,20 @@ class SSSP(ParallelAppBase):
                     "SSSP", "fragment has no edge weights"
                 )
             else:
-                self._pack_plan = plan_pack_for_fragment(
-                    frag, with_weights=True
+                self._pack = resolve_pack_dispatch(
+                    frag, with_weights=True, mirror=self._mx
                 )
-                if self._pack_plan is None:
-                    warn_pack_ineligible(
-                        "SSSP", "plan_pack_for_fragment returned no plan"
-                    )
+                if self._pack is None:
+                    warn_pack_ineligible("SSSP", "no pack plan buildable")
+                else:
+                    eph_entries.update(self._pack.state_entries())
+        if eph_entries:
+            state.update(eph_entries)
+            self.ephemeral_keys = frozenset(eph_entries)
         self._pack_plan_uid = (
-            self._pack_plan.uid if self._pack_plan is not None else -1
+            self._pack.uid if self._pack is not None else -1
         )
-        return {"dist": dist}
+        return state
 
     def peval(self, ctx: StepContext, frag, state):
         # The reference PEval relaxes only the source's out-edges
@@ -82,17 +96,18 @@ class SSSP(ParallelAppBase):
     def inceval(self, ctx: StepContext, frag, state):
         dist = state["dist"]
         ie = frag.ie
-        full = ctx.gather_state(dist)
-        if self._pack_plan is not None:
-            from libgrape_lite_tpu.ops.spmv_pack import (
-                segment_reduce_pack,
-            )
-
-            relaxed = segment_reduce_pack(full, self._pack_plan, "min")
+        if self._mx is not None:
+            full = ctx.exchange_mirrors(dist, state["mx_send"])
+            nbr = state["mx_nbr"]
+        else:
+            full = ctx.gather_state(dist)
+            nbr = ie.edge_nbr
+        if self._pack is not None:
+            relaxed = self._pack.reduce(full, state, "min")
         else:
             inf = jnp.asarray(jnp.inf, dist.dtype)
             cand = jnp.where(
-                ie.edge_mask, full[ie.edge_nbr] + ie.edge_w, inf
+                ie.edge_mask, full[nbr] + ie.edge_w, inf
             )
             relaxed = self.segment_reduce(cand, ie.edge_src, frag.vp, "min")
         new = jnp.minimum(dist, relaxed)

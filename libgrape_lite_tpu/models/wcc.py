@@ -27,19 +27,86 @@ class WCC(ParallelAppBase):
     result_format = "int"
 
     def init_state(self, frag, **_):
+        import os
+
         vp = frag.vp
         pids = np.arange(frag.fnum * vp, dtype=np.int32).reshape(frag.fnum, vp)
         # padded rows get a big sentinel so they never win a min
         comp = np.where(frag.host_inner_mask(), pids, np.iinfo(np.int32).max)
-        return {"comp": comp.astype(np.int32)}
+        state = {"comp": comp.astype(np.int32)}
+        eph_entries = {}
+        # mirror-compressed exchange (GRAPE_EXCHANGE=mirror), per pull
+        # direction
+        self._mx_ie = self._mx_oe = None
+        if os.environ.get("GRAPE_EXCHANGE") == "mirror" and frag.fnum > 1:
+            from libgrape_lite_tpu.parallel.mirror import (
+                build_mirror_plan,
+            )
+
+            self._mx_ie = build_mirror_plan(frag, "ie")
+            eph_entries.update(self._mx_ie.state_entries("mx_ie_"))
+            if frag.directed:
+                self._mx_oe = build_mirror_plan(frag, "oe")
+                eph_entries.update(self._mx_oe.state_entries("mx_oe_"))
+        self._mx_uid = self._mx_ie.uid if self._mx_ie is not None else -1
+        # pack-gather min pull (GRAPE_SPMV=pack): the label space must
+        # stay exactly representable in f32 (labels are pids < 2^24)
+        self._pack_ie = self._pack_oe = None
+        if os.environ.get("GRAPE_SPMV") == "pack":
+            from libgrape_lite_tpu.ops.spmv_pack import (
+                resolve_pack_dispatch,
+                warn_pack_ineligible,
+            )
+
+            if frag.fnum * vp > (1 << 24):
+                warn_pack_ineligible(
+                    "WCC", "pid label space exceeds exact f32 range (2^24)"
+                )
+            else:
+                ie = resolve_pack_dispatch(frag, direction="ie",
+                                           prefix="pk_ie_",
+                                           mirror=self._mx_ie)
+                oe = (
+                    resolve_pack_dispatch(frag, direction="oe",
+                                          prefix="pk_oe_",
+                                          mirror=self._mx_oe)
+                    if frag.directed else None
+                )
+                if ie is None or (frag.directed and oe is None):
+                    warn_pack_ineligible("WCC", "no pack plan buildable")
+                else:
+                    self._pack_ie, self._pack_oe = ie, oe
+                    eph_entries.update(ie.state_entries())
+                    if oe is not None:
+                        eph_entries.update(oe.state_entries())
+        if eph_entries:
+            state.update(eph_entries)
+            self.ephemeral_keys = frozenset(eph_entries)
+        self._pack_uid = (
+            self._pack_ie.uid if self._pack_ie is not None else -1
+        )
+        return state
 
     def peval(self, ctx: StepContext, frag, state):
         return state, jnp.int32(1)
 
-    def _pull(self, ctx, frag, comp, csr):
-        full = ctx.gather_state(comp)
+    def _pull(self, ctx, frag, comp, csr, pack=None, state=None,
+              mx=None, mx_prefix="mx_ie_"):
         big = jnp.int32(np.iinfo(np.int32).max)
-        cand = jnp.where(csr.edge_mask, full[csr.edge_nbr], big)
+        if mx is not None:
+            full = ctx.exchange_mirrors(comp, state[mx_prefix + "send"])
+            nbr = state[mx_prefix + "nbr"]
+        else:
+            full = ctx.gather_state(comp)
+            nbr = csr.edge_nbr
+        if pack is not None:
+            # tropical min over the static pack routes: labels travel
+            # as exact f32 ints; rows with no edges come back +inf
+            red = pack.reduce(full.astype(jnp.float32), state, "min")
+            return jnp.where(
+                jnp.isfinite(red), red.astype(jnp.int32), big
+            )
+        cand = jnp.where(csr.edge_mask, full[nbr], big)
         return self.segment_reduce(cand, csr.edge_src, frag.vp, "min")
 
     def _post_pull(self, ctx, frag, new):
@@ -49,9 +116,17 @@ class WCC(ParallelAppBase):
 
     def inceval(self, ctx: StepContext, frag, state):
         comp = state["comp"]
-        new = jnp.minimum(comp, self._pull(ctx, frag, comp, frag.ie))
+        new = jnp.minimum(
+            comp,
+            self._pull(ctx, frag, comp, frag.ie, self._pack_ie, state,
+                       self._mx_ie, "mx_ie_"),
+        )
         if frag.directed:
-            new = jnp.minimum(new, self._pull(ctx, frag, new, frag.oe))
+            new = jnp.minimum(
+                new,
+                self._pull(ctx, frag, new, frag.oe, self._pack_oe, state,
+                           self._mx_oe, "mx_oe_"),
+            )
         new = self._post_pull(ctx, frag, new)
         changed = jnp.logical_and(new < comp, frag.inner_mask)
         active = ctx.sum(changed.sum().astype(jnp.int32))

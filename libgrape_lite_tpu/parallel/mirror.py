@@ -1,0 +1,144 @@
+"""Mirror-compressed state exchange: sync outer vertices only.
+
+Re-design of the reference's batch-shuffle mirror sync
+(`grape/parallel/batch_shuffle_message_manager.h:237-264`, mirror lists
+from `grape/fragment/edgecut_fragment_base.h:569-602`): instead of
+all_gathering the FULL per-vertex state vector — O(fnum*vp) HBM per
+device and O(N) ICI bytes per round regardless of cut quality — each
+shard sends every neighbor shard exactly the state rows that shard's
+edges reference (its outer-vertex mirrors).
+
+TPU formulation (static shapes, one collective):
+
+  host/prepare time: per (receiver f, sender g) the request list
+  req[f][g] = sorted unique pids of shard g referenced by f's edges.
+  M = max |req| padded to the lane width; the send table for shard g
+  is `send_idx[g]` [fnum, M] (rows ordered by receiver), and every
+  edge column is remapped into the COMPACT index space
+  [vp local | g0 mirrors | g1 mirrors | ...] of length vp + fnum*M.
+
+  per round (inside shard_map): one gather x_local[send_idx] ->
+  [fnum, M], one `all_to_all`, one concat -> x_compact.  ICI bytes
+  drop from fnum*vp to fnum*M per device per round; state never
+  materialises at O(fnum*vp).
+
+The compact column space composes with the pack-gather SpMV: pack
+plans built over `nbr_compact` gather from x_compact, shrinking the
+pass table from fnum*vp to vp + fnum*M entries.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+import numpy as np
+
+_UID = itertools.count(1)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclass
+class MirrorPlan:
+    """Static routing for the mirror exchange of one fragment+direction."""
+
+    fnum: int
+    vp: int
+    m: int                     # mirror slots per (sender, receiver) pair
+    n_compact: int             # vp + fnum * m
+    send_idx: np.ndarray       # [fnum(sender), fnum(receiver), m] int32 lids
+    nbr_compact: np.ndarray    # [fnum, Ep] int32 compact edge columns
+    uid: int = field(default_factory=lambda: next(_UID))
+
+    @property
+    def bytes_all_gather(self) -> int:
+        """Per-device ICI bytes per round of the full-state all_gather
+        this plan replaces (f32 payload)."""
+        return self.fnum * self.vp * 4
+
+    @property
+    def bytes_mirror(self) -> int:
+        """Per-device ICI bytes per round of the mirror all_to_all."""
+        return self.fnum * self.m * 4
+
+    def state_entries(self, prefix: str) -> dict:
+        """Ephemeral state leaves ([fnum, ...], sharded on dim 0)."""
+        return {
+            prefix + "send": self.send_idx,
+            prefix + "nbr": self.nbr_compact,
+        }
+
+
+_FRAG_MIRROR_CACHE = None
+
+
+def build_mirror_plan(frag, direction: str = "ie") -> MirrorPlan | None:
+    """Build (and cache per fragment) the mirror plan for `frag`'s
+    pull over `direction` ("ie" | "oe").  Returns None for fnum == 1
+    (nothing to exchange — apps use local state directly)."""
+    global _FRAG_MIRROR_CACHE
+    import weakref
+
+    if frag.fnum == 1:
+        return None
+    if _FRAG_MIRROR_CACHE is None:
+        _FRAG_MIRROR_CACHE = weakref.WeakKeyDictionary()
+    per_frag = _FRAG_MIRROR_CACHE.setdefault(frag, {})
+    if direction in per_frag:
+        return per_frag[direction]
+
+    fnum, vp = frag.fnum, frag.vp
+    csrs = frag.host_ie if direction == "ie" else frag.host_oe
+
+    # per (receiver f, sender g) sorted unique request lists
+    reqs: list[list[np.ndarray]] = []
+    m = 1
+    for f in range(fnum):
+        h = csrs[f]
+        nbr = h.edge_nbr[h.edge_mask].astype(np.int64)
+        row = []
+        g_of = nbr // vp
+        for g in range(fnum):
+            if g == f:
+                row.append(np.zeros(0, np.int64))
+                continue
+            r = np.unique(nbr[g_of == g])
+            row.append(r)
+            m = max(m, len(r))
+        reqs.append(row)
+    m = _round_up(m, 128)
+
+    send_idx = np.zeros((fnum, fnum, m), dtype=np.int32)
+    for g in range(fnum):
+        for f in range(fnum):
+            if f == g:
+                continue
+            r = reqs[f][g]
+            send_idx[g, f, : len(r)] = (r % vp).astype(np.int32)
+
+    ep = csrs[0].edge_nbr.shape[0]
+    nbr_compact = np.zeros((fnum, ep), dtype=np.int32)
+    for f in range(fnum):
+        h = csrs[f]
+        nbr = h.edge_nbr.astype(np.int64)
+        g_of = nbr // vp
+        out = np.where(g_of == f, nbr % vp, 0).astype(np.int64)
+        for g in range(fnum):
+            if g == f:
+                continue
+            sel = g_of == g
+            if not sel.any():
+                continue
+            pos = np.searchsorted(reqs[f][g], nbr[sel])
+            out[sel] = vp + g * m + pos
+        nbr_compact[f] = np.where(h.edge_mask, out, 0).astype(np.int32)
+
+    plan = MirrorPlan(
+        fnum=fnum, vp=vp, m=m, n_compact=vp + fnum * m,
+        send_idx=send_idx, nbr_compact=nbr_compact,
+    )
+    per_frag[direction] = plan
+    return plan

@@ -112,13 +112,21 @@ class Worker:
     def _make_runner(self, max_rounds: int):
         app = self.app
         mesh, frag_spec = self._mesh_layout()
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
 
         def stepper(frag_stacked, state, squeezed):
             frag = frag_stacked.local()
-            st = _squeeze_state(state, squeezed)
-            ctx = StepContext()
+            st_all = _squeeze_state(state, squeezed)
+            # ephemeral leaves (pack stream tables etc.): trace inputs
+            # visible to peval/inceval, excluded from the loop carry
+            eph_vals = {k: st_all[k] for k in eph}
 
-            st, active = app.peval(ctx, frag, st)
+            def strip(s):
+                return {k: v for k, v in s.items() if k not in eph}
+
+            ctx = StepContext()
+            st, active = app.peval(ctx, frag, st_all)
+            st = strip(st)
             limit = jnp.int32(max_rounds if max_rounds > 0 else _INT32_MAX)
 
             def cond(carry):
@@ -127,8 +135,8 @@ class Worker:
 
             def body(carry):
                 s, _, r = carry
-                s2, a2 = app.inceval(ctx, frag, s)
-                return s2, jnp.int32(a2), r + jnp.int32(1)
+                s2, a2 = app.inceval(ctx, frag, {**s, **eph_vals})
+                return strip(s2), jnp.int32(a2), r + jnp.int32(1)
 
             st, active, rounds = lax.while_loop(
                 cond, body, (st, jnp.int32(active), jnp.int32(0))
@@ -137,11 +145,14 @@ class Worker:
 
         def compile_for(state):
             specs, squeezed = self._key_specs(state)
+            out_state_specs = {
+                k: v for k, v in specs.items() if k not in eph
+            }
             sm = jax.shard_map(
                 partial(stepper, squeezed=squeezed),
                 mesh=mesh,
                 in_specs=(frag_spec, specs),
-                out_specs=(specs, P(), P()),
+                out_specs=(out_state_specs, P(), P()),
                 check_vma=False,
             )
             return jax.jit(sm)
@@ -212,6 +223,8 @@ class Worker:
         app = self.app
         mesh, frag_spec = self._mesh_layout()
         specs, squeezed = self._key_specs(state)
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        out_specs = {k: v for k, v in specs.items() if k not in eph}
 
         def fn(frag_stacked, st):
             lf = frag_stacked.local()
@@ -223,12 +236,13 @@ class Worker:
                 app.peval(ctx, lf, s) if kind == "peval"
                 else app.inceval(ctx, lf, s)
             )
+            s2 = {k: v for k, v in s2.items() if k not in eph}
             return _unsqueeze_state(s2, squeezed), jnp.int32(active)
 
         return jax.jit(
             jax.shard_map(
                 fn, mesh=mesh, in_specs=(frag_spec, specs),
-                out_specs=(specs, P()), check_vma=False,
+                out_specs=(out_specs, P()), check_vma=False,
             )
         )
 
@@ -256,9 +270,14 @@ class Worker:
         state = self._place_state(app.init_state(frag, **query_args))
         peval_fn = self._compile_single_step("peval", state)
         inc_fn = self._compile_single_step("inceval", state)
+        # ephemeral leaves drop out of each step's outputs; re-merge the
+        # placed originals so the next step's inputs stay complete
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        eph_vals = {k: state[k] for k in eph}
 
         t0 = time.perf_counter()
         state, active = jax.block_until_ready(peval_fn(frag.dev, state))
+        state = {**state, **eph_vals}
         glog.vlog(1, f"PEval: {time.perf_counter() - t0:.6f}s active={int(active)}")
         rounds = 0
         has_mutations = hasattr(app, "collect_mutations")
@@ -288,11 +307,15 @@ class Worker:
             state, frag, inc_fn, changed = apply_mutations_if_any(
                 state, frag, inc_fn, 0
             )
+            if changed:
+                # the rebuilt state carries fresh ephemeral leaves
+                eph_vals = {k: state[k] for k in eph}
             if changed and int(active) >= 0:
                 active = 1
         while int(active) > 0 and rounds < mr:
             t0 = time.perf_counter()
             state, active = jax.block_until_ready(inc_fn(frag.dev, state))
+            state = {**state, **eph_vals}
             rounds += 1
             glog.vlog(
                 1,
@@ -305,6 +328,8 @@ class Worker:
                 state, frag, inc_fn, changed = apply_mutations_if_any(
                     state, frag, inc_fn, rounds
                 )
+                if changed:
+                    eph_vals = {k: state[k] for k in eph}
                 if changed and int(active) >= 0:
                     active = 1  # the new topology must be re-evaluated
                     if rounds >= mr:
