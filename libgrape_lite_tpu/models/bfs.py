@@ -80,8 +80,13 @@ class BFS(ParallelAppBase):
     def inceval(self, ctx: StepContext, frag, state):
         depth = state["depth"]
         ie = frag.ie
-        full = ctx.gather_state(depth)
         sent = jnp.int32(_SENTINEL)
+        if self._mx is not None:
+            full = ctx.exchange_mirrors(depth, state["mx_send"])
+            nbr = state["mx_nbr"]
+        else:
+            full = ctx.gather_state(depth)
+            nbr = ie.edge_nbr
         if self._pack is not None:
             full_f = jnp.where(
                 full == sent, jnp.float32(jnp.inf),
@@ -92,7 +97,7 @@ class BFS(ParallelAppBase):
                 jnp.isfinite(red), red.astype(jnp.int32), sent
             )
         else:
-            nbr_d = full[ie.edge_nbr]
+            nbr_d = full[nbr]
             cand = jnp.where(
                 jnp.logical_and(ie.edge_mask, nbr_d != sent),
                 nbr_d + 1, sent,

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Background TPU liveness watcher: probes the axon backend every 4 min.
+# Exits 0 (notifying the driver) the moment the chip answers; writes
+# /root/repo/.tpu_alive with a timestamp. Caps out after ~11h.
+for i in $(seq 1 160); do
+  if timeout 90 env JAX_PLATFORMS=axon python -c "import jax; d=jax.devices(); assert d" >/dev/null 2>&1; then
+    date -u +"%Y-%m-%dT%H:%M:%SZ alive (iter $i)" > /root/repo/.tpu_alive
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) iter $i: dead" >> /root/repo/.tpu_watch.log
+  sleep 240
+done
+exit 1

@@ -1,0 +1,254 @@
+"""Mirror-compressed exchange coverage (VERDICT r3 weak #2 / next #3).
+
+The reference syncs outer-vertex mirrors per neighbor fragment
+(`grape/parallel/batch_shuffle_message_manager.h:237-264`, mirror lists
+from `grape/fragment/edgecut_fragment_base.h:569-602`); here that is
+`parallel/mirror.py` + `StepContext.exchange_mirrors`.  Lanes:
+
+* numpy unit test of `build_mirror_plan`'s `nbr_compact` remap
+  (masked edges included) against a direct per-receiver reconstruction,
+* golden matrix: GRAPE_EXCHANGE=mirror x {pagerank, sssp, wcc, bfs} x
+  fnum {2,4,8} against `dataset/p2p-31-*`,
+* pack x mirror composition: both envs set, compared to the default
+  gather/XLA path on a random multigraph.
+"""
+
+import numpy as np
+import pytest
+
+from tests.conftest import dataset_path
+from tests.verifiers import (
+    collect_worker_result as run_worker,
+    eps_verify,
+    exact_verify,
+    load_golden,
+    wcc_verify,
+)
+
+FNUMS = [2, 4, 8]
+
+
+def _rand_frag(fnum, n=900, e=7000, seed=11, weighted=True, directed=False):
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.partitioner import MapPartitioner
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    w = (
+        rng.uniform(0.5, 4.0, e).astype(np.float32)
+        if weighted
+        else np.ones(e, dtype=np.float32)
+    )
+    oids = np.arange(n, dtype=np.int64)
+    comm = CommSpec(fnum=fnum)
+    vm = VertexMap.build(oids, MapPartitioner(fnum, oids))
+    return ShardedEdgecutFragment.build(
+        comm, vm, src, dst, w, directed=directed,
+        load_strategy=LoadStrategy.kBothOutIn,
+    )
+
+
+@pytest.mark.parametrize("fnum", [2, 4])
+@pytest.mark.parametrize("direction", ["ie", "oe"])
+def test_mirror_plan_remap(fnum, direction):
+    """nbr_compact must address exactly the values the exchange lays
+    out: [local vp | g0 mirrors | g1 mirrors | ...], masked edges
+    pinned to column 0."""
+    from libgrape_lite_tpu.parallel.mirror import build_mirror_plan
+
+    frag = _rand_frag(fnum, n=700, e=5000, seed=23)
+    plan = build_mirror_plan(frag, direction)
+    assert plan is not None
+    vp = frag.vp
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=fnum * vp)
+    csrs = frag.host_ie if direction == "ie" else frag.host_oe
+    for f in range(fnum):
+        # receiver f's compact table: local block then, per sender g,
+        # the rows g gathered through send_idx[g, f]
+        compact = np.concatenate(
+            [x[f * vp:(f + 1) * vp]]
+            + [x[g * vp + plan.send_idx[g, f]] for g in range(fnum)]
+        )
+        assert compact.shape[0] == plan.n_compact
+        h = csrs[f]
+        mask = h.edge_mask
+        np.testing.assert_array_equal(
+            compact[plan.nbr_compact[f][mask]], x[h.edge_nbr[mask]]
+        )
+        # masked edges are parked on a valid local column
+        assert (plan.nbr_compact[f][~mask] == 0).all()
+
+
+def test_mirror_bytes_win(graph_cache):
+    """On a real cut the mirror exchange must move fewer ICI bytes than
+    the all_gather it replaces (else wiring it in is pointless)."""
+    from libgrape_lite_tpu.parallel.mirror import build_mirror_plan
+
+    frag = graph_cache(8)
+    plan = build_mirror_plan(frag, "ie")
+    assert plan is not None
+    assert plan.bytes_mirror < plan.bytes_all_gather
+
+
+# ---- golden matrix lanes (p2p-31, the reference app_tests goldens) ----
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_sssp_mirror_golden(graph_cache, fnum, monkeypatch):
+    from libgrape_lite_tpu.models import SSSP
+
+    monkeypatch.setenv("GRAPE_EXCHANGE", "mirror")
+    res = run_worker(SSSP(), graph_cache(fnum), source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-SSSP")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_bfs_mirror_golden(graph_cache, fnum, monkeypatch):
+    from libgrape_lite_tpu.models import BFS
+
+    monkeypatch.setenv("GRAPE_EXCHANGE", "mirror")
+    res = run_worker(BFS(), graph_cache(fnum), source=6)
+    exact_verify(res, load_golden(dataset_path("p2p-31-BFS")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_pagerank_mirror_golden(graph_cache, fnum, monkeypatch):
+    from libgrape_lite_tpu.models import PageRank
+
+    monkeypatch.setenv("GRAPE_EXCHANGE", "mirror")
+    res = run_worker(
+        PageRank(), graph_cache(fnum), delta=0.85, max_round=10
+    )
+    eps_verify(res, load_golden(dataset_path("p2p-31-PR")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_wcc_mirror_golden(graph_cache, fnum, monkeypatch):
+    from libgrape_lite_tpu.models import WCC
+
+    monkeypatch.setenv("GRAPE_EXCHANGE", "mirror")
+    res = run_worker(WCC(), graph_cache(fnum))
+    wcc_verify(res, load_golden(dataset_path("p2p-31-WCC")))
+
+
+# ---- pack x mirror composition ----
+
+
+def _small_pack(monkeypatch):
+    # the mirror branch of resolve_pack_dispatch calls plan_pack_multi
+    # directly, so patch that (not plan_pack_multi_for_fragment) to
+    # force multi-block fold/hub geometry on the tiny test shards
+    import libgrape_lite_tpu.ops.spmv_pack as sp
+    from libgrape_lite_tpu.ops.spmv_pack import PackConfig
+
+    orig = sp.plan_pack_multi
+
+    def small_cfg(shards, vp, n_cols, cfg=None):
+        return orig(shards, vp, n_cols,
+                    PackConfig(sub=16, out_sub=8, hub=128))
+
+    monkeypatch.setattr(sp, "plan_pack_multi", small_cfg)
+
+
+@pytest.mark.parametrize("fnum", [2, 4])
+def test_pagerank_pack_mirror(monkeypatch, fnum):
+    """Pack plans built over the compact mirror columns must match the
+    default gather/XLA path."""
+    from libgrape_lite_tpu.models import PageRank
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = _rand_frag(fnum, seed=80 + fnum, weighted=False)
+    monkeypatch.delenv("GRAPE_SPMV", raising=False)
+    monkeypatch.delenv("GRAPE_EXCHANGE", raising=False)
+    w_ref = Worker(PageRank(max_round=6), frag)
+    w_ref.query()
+    ref = w_ref.result_values()
+
+    monkeypatch.setenv("GRAPE_SPMV", "pack")
+    monkeypatch.setenv("GRAPE_EXCHANGE", "mirror")
+    _small_pack(monkeypatch)
+    app = PageRank(max_round=6)
+    wk = Worker(app, frag)
+    wk.query()
+    assert app._pack is not None, "pack plan not engaged"
+    assert app._mx is not None, "mirror plan not engaged"
+    got = wk.result_values()
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-7)
+
+
+@pytest.mark.parametrize("fnum", [2, 4])
+def test_sssp_pack_mirror(monkeypatch, fnum):
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = _rand_frag(fnum, seed=90 + fnum)
+    monkeypatch.delenv("GRAPE_SPMV", raising=False)
+    monkeypatch.delenv("GRAPE_EXCHANGE", raising=False)
+    w_ref = Worker(SSSP(), frag)
+    w_ref.query(source=0)
+    ref = w_ref.result_values()
+
+    monkeypatch.setenv("GRAPE_SPMV", "pack")
+    monkeypatch.setenv("GRAPE_EXCHANGE", "mirror")
+    _small_pack(monkeypatch)
+    app = SSSP()
+    wk = Worker(app, frag)
+    wk.query(source=0)
+    assert app._pack is not None, "pack plan not engaged"
+    assert app._mx is not None, "mirror plan not engaged"
+    got = wk.result_values()
+    finite = np.isfinite(ref)
+    np.testing.assert_allclose(got[finite], ref[finite], rtol=1e-6)
+    assert np.isinf(got[~finite]).all()
+
+
+@pytest.mark.parametrize("fnum", [2, 4])
+def test_bfs_pack_mirror(monkeypatch, fnum):
+    """The ADVICE r3 high finding: BFS with mirror+pack used to feed the
+    full gather table to a compact-column plan."""
+    from libgrape_lite_tpu.models import BFS
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = _rand_frag(fnum, seed=100 + fnum, weighted=False)
+    monkeypatch.delenv("GRAPE_SPMV", raising=False)
+    monkeypatch.delenv("GRAPE_EXCHANGE", raising=False)
+    w_ref = Worker(BFS(), frag)
+    w_ref.query(source=0)
+    ref = w_ref.result_values()
+
+    monkeypatch.setenv("GRAPE_SPMV", "pack")
+    monkeypatch.setenv("GRAPE_EXCHANGE", "mirror")
+    _small_pack(monkeypatch)
+    app = BFS()
+    wk = Worker(app, frag)
+    wk.query(source=0)
+    assert app._pack is not None, "pack plan not engaged"
+    assert app._mx is not None, "mirror plan not engaged"
+    np.testing.assert_array_equal(wk.result_values(), ref)
+
+
+@pytest.mark.parametrize("fnum", [2, 4])
+def test_bfs_mirror_no_pack(monkeypatch, fnum):
+    """Mirror without pack: BFS must actually route through
+    exchange_mirrors (previously silently inert — ADVICE r3 high)."""
+    from libgrape_lite_tpu.models import BFS
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = _rand_frag(fnum, seed=110 + fnum, weighted=False)
+    monkeypatch.delenv("GRAPE_SPMV", raising=False)
+    monkeypatch.delenv("GRAPE_EXCHANGE", raising=False)
+    w_ref = Worker(BFS(), frag)
+    w_ref.query(source=0)
+    ref = w_ref.result_values()
+
+    monkeypatch.setenv("GRAPE_EXCHANGE", "mirror")
+    app = BFS()
+    wk = Worker(app, frag)
+    wk.query(source=0)
+    assert app._mx is not None, "mirror plan not engaged"
+    np.testing.assert_array_equal(wk.result_values(), ref)
