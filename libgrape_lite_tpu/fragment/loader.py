@@ -128,56 +128,174 @@ def LoadGraph(
     return frag
 
 
+# ---- archive-backed cache format (utils/archive.py) ---------------------
+#
+# The reference serializes fragments through InArchive/OutArchive with
+# delta-varint gid compression (`basic_fragment_loader_base.h:127-242`,
+# `grape/utils/varint.h`); the TPU build does the same at the host
+# boundary: CSR indptr / edge_src are non-decreasing -> delta-varint
+# (3-5x smaller than raw int64), edge_nbr -> plain varint, masks ->
+# packed bits, weights raw.  One `frag.garc` file per partition.
+
+_GARC_MAGIC = 0x47415243  # "GARC"
+
+# stream encodings (flag byte per array)
+_ENC_RAW, _ENC_VARINT, _ENC_DELTA, _ENC_BITS, _ENC_PICKLE = range(5)
+
+
+def _put_array(ar, a: np.ndarray) -> None:
+    """Append one array: flag byte, element count, payload, dtype tag."""
+    a = np.asarray(a)
+    if a.dtype == object:  # string oids
+        import pickle
+
+        blob = pickle.dumps(a)
+        ar.add_scalar(_ENC_PICKLE, "<b")
+        ar.add_scalar(len(blob))
+        ar.add_bytes(blob)
+        return
+    from libgrape_lite_tpu.utils.archive import (
+        delta_varint_encode, varint_encode,
+    )
+
+    if a.dtype == np.bool_:
+        ar.add_scalar(_ENC_BITS, "<b")
+        ar.add_scalar(len(a))
+        ar.add_bytes(np.packbits(a).tobytes())
+    elif np.issubdtype(a.dtype, np.integer) and (
+        len(a) == 0 or (int(a.min()) >= 0 and int(a.max()) < (1 << 62))
+    ):
+        monotone = len(a) > 0 and bool((np.diff(a) >= 0).all())
+        ar.add_scalar(_ENC_DELTA if monotone else _ENC_VARINT, "<b")
+        ar.add_scalar(len(a))
+        enc = (delta_varint_encode if monotone else varint_encode)(
+            a.astype(np.uint64)
+        )
+        ar.add_scalar(len(enc))
+        ar.add_bytes(enc)
+    else:
+        ar.add_scalar(_ENC_RAW, "<b")
+        ar.add_scalar(len(a))
+        ar.add_array(a)
+    tag = a.dtype.str.encode()
+    ar.add_scalar(len(tag), "<b")
+    ar.add_bytes(tag)
+
+
+def _get_array(oa) -> np.ndarray:
+    import pickle
+
+    from libgrape_lite_tpu.utils.archive import (
+        delta_varint_decode, varint_decode,
+    )
+
+    enc = oa.get_scalar("<b")
+    if enc == _ENC_PICKLE:
+        nbytes = oa.get_scalar()
+        return pickle.loads(bytes(oa.get_bytes(nbytes)))
+    n = oa.get_scalar()
+    if enc == _ENC_BITS:
+        vals = np.unpackbits(
+            np.frombuffer(oa.get_bytes((n + 7) // 8), np.uint8)
+        )[:n].astype(bool)
+    elif enc in (_ENC_VARINT, _ENC_DELTA):
+        nbytes = oa.get_scalar()
+        buf = bytes(oa.get_bytes(nbytes))
+        vals = (
+            delta_varint_decode(buf) if enc == _ENC_DELTA
+            else varint_decode(buf)
+        )
+    else:
+        vals = oa.get_array(np.uint8)
+    tl = oa.get_scalar("<b")
+    dt = np.dtype(bytes(oa.get_bytes(tl)).decode())
+    if enc == _ENC_RAW:
+        return vals.view(dt).copy()
+    return vals.astype(dt)
+
+
 def _serialize_fragment(frag: ShardedEdgecutFragment, cache: str, sig: str):
+    from libgrape_lite_tpu.utils.archive import InArchive
+
     os.makedirs(cache, exist_ok=True)
     vm = frag.vertex_map
     aliased = frag.host_ie is frag.host_oe
-    arrays = {
-        "fnum": np.int64(frag.fnum),
-        "vp": np.int64(frag.vp),
-        "directed": np.int64(frag.directed),
-        "weighted": np.int64(frag.weighted),
-        "aliased": np.int64(aliased),
-        "total_vnum": np.int64(frag.dev.total_vnum),
-        "total_enum": np.int64(frag.dev.total_enum),
-    }
+    ar = InArchive()
+    ar.add_scalar(_GARC_MAGIC)
+    ar.add_scalar(2)  # format version
+    for v in (
+        frag.fnum, frag.vp, int(frag.directed), int(frag.weighted),
+        int(aliased), frag.dev.total_vnum, frag.dev.total_enum,
+    ):
+        ar.add_scalar(int(v))
     sides = [("oe", frag.host_oe)] if aliased else [
         ("oe", frag.host_oe), ("ie", frag.host_ie)
     ]
     for f in range(frag.fnum):
-        arrays[f"oids_{f}"] = vm.inner_oids(f)
+        _put_array(ar, vm.inner_oids(f))
         for side, csrs in sides:
             c = csrs[f]
-            arrays[f"{side}_indptr_{f}"] = c.indptr
-            arrays[f"{side}_src_{f}"] = c.edge_src
-            arrays[f"{side}_nbr_{f}"] = c.edge_nbr
-            arrays[f"{side}_mask_{f}"] = c.edge_mask
-            arrays[f"{side}_ne_{f}"] = np.int64(c.num_edges)
+            _put_array(ar, c.indptr)
+            _put_array(ar, c.edge_src)
+            _put_array(ar, c.edge_nbr)
+            _put_array(ar, c.edge_mask)
+            ar.add_scalar(c.num_edges)
+            ar.add_scalar(0 if c.edge_w is None else 1, "<b")
             if c.edge_w is not None:
-                arrays[f"{side}_w_{f}"] = c.edge_w
-    np.savez_compressed(os.path.join(cache, "frag.npz"), **arrays)
+                _put_array(ar, c.edge_w)
+    import zlib
+
+    # deflate over the archive: the varint streams are already small,
+    # and the float payloads (weights) get the entropy coding varint
+    # can't give them
+    with open(os.path.join(cache, "frag.garc"), "wb") as fh:
+        fh.write(zlib.compress(ar.get_buffer(), 6))
     with open(os.path.join(cache, "sig"), "w") as f:
         f.write(sig)
 
 
-def _deserialize_fragment(
-    cache: str, comm_spec: CommSpec, spec: LoadGraphSpec
-) -> ShardedEdgecutFragment:
-    from libgrape_lite_tpu.graph.csr import CSR
+def _read_garc(cache: str):
+    """Parse frag.garc -> (meta dict, per-fragment streams)."""
+    import zlib
+
+    from libgrape_lite_tpu.utils.archive import OutArchive
+
+    with open(os.path.join(cache, "frag.garc"), "rb") as fh:
+        oa = OutArchive(zlib.decompress(fh.read()))
+    if oa.get_scalar() != _GARC_MAGIC:
+        raise ValueError("bad garc magic")
+    version = oa.get_scalar()
+    if version != 2:
+        raise ValueError(f"unsupported garc version {version}")
+    (fnum, vp, directed, weighted, aliased, total_vnum,
+     total_enum) = (oa.get_scalar() for _ in range(7))
+    meta = dict(
+        fnum=fnum, vp=vp, directed=bool(directed),
+        weighted=bool(weighted), aliased=bool(aliased),
+        total_vnum=total_vnum, total_enum=total_enum,
+    )
+    sides = ["oe"] if aliased else ["oe", "ie"]
+    frags = []
+    for _f in range(fnum):
+        entry = {"oids": _get_array(oa)}
+        for side in sides:
+            indptr = _get_array(oa)
+            src = _get_array(oa)
+            nbr = _get_array(oa)
+            mask = _get_array(oa)
+            ne = oa.get_scalar()
+            has_w = oa.get_scalar("<b")
+            w = _get_array(oa) if has_w else None
+            entry[side] = (indptr, src, nbr, mask, ne, w)
+        frags.append(entry)
+    assert oa.empty(), "trailing bytes in frag.garc"
+    return meta, frags
+
+
+def _rebuild_vertex_map(all_oids, fnum: int, vp: int, spec) -> VertexMap:
+    """Rebuild the exact fid assignment from per-fragment oid lists
+    (oids_f belongs to fragment f) — shared by both cache formats."""
     from libgrape_lite_tpu.utils.id_parser import IdParser
-
-    z = np.load(os.path.join(cache, "frag.npz"), allow_pickle=True)
-    fnum = int(z["fnum"])
-    if fnum != comm_spec.fnum:
-        raise ValueError(
-            f"serialized fnum={fnum} != requested {comm_spec.fnum}"
-        )
-    vp = int(z["vp"])
-    directed = bool(z["directed"])
-    weighted = bool(z["weighted"])
-
-    all_oids = [z[f"oids_{f}"] for f in range(fnum)]
-    # rebuild exact fid assignment: oids_f belongs to fragment f
     from libgrape_lite_tpu.vertex_map.idxer import make_idxer
     from libgrape_lite_tpu.vertex_map.partitioner import ExplicitPartitioner
 
@@ -189,7 +307,61 @@ def _deserialize_fragment(
     ) if all_oids else np.zeros(0, np.int64)
     part = ExplicitPartitioner(flat_oids, flat_fids)
     part.fnum = fnum
-    vm = VertexMap(part, idxers, id_parser)
+    return VertexMap(part, idxers, id_parser)
+
+
+def _deserialize_fragment(
+    cache: str, comm_spec: CommSpec, spec: LoadGraphSpec
+) -> ShardedEdgecutFragment:
+    from libgrape_lite_tpu.graph.csr import CSR
+
+    if os.path.exists(os.path.join(cache, "frag.garc")):
+        meta, frags = _read_garc(cache)
+        fnum = meta["fnum"]
+        if fnum != comm_spec.fnum:
+            raise ValueError(
+                f"serialized fnum={fnum} != requested {comm_spec.fnum}"
+            )
+        vp = meta["vp"]
+        directed, weighted = meta["directed"], meta["weighted"]
+        vm = _rebuild_vertex_map(
+            [e["oids"] for e in frags], fnum, vp, spec
+        )
+
+        def csr_from(e, side):
+            indptr, src, nbr, mask, ne, w = e[side]
+            return CSR(
+                indptr=indptr, edge_src=src, edge_nbr=nbr, edge_w=w,
+                edge_mask=mask, num_rows=vp, num_edges=ne,
+            )
+
+        host_oe = [csr_from(e, "oe") for e in frags]
+        host_ie = (
+            host_oe if meta["aliased"]
+            else [csr_from(e, "ie") for e in frags]
+        )
+        dev = ShardedEdgecutFragment._device_put(
+            comm_spec, vm, host_oe, host_ie, vp, directed,
+            meta["total_vnum"], meta["total_enum"],
+        )
+        return ShardedEdgecutFragment(
+            comm_spec, vm, dev, host_oe, host_ie, directed, weighted
+        )
+
+    # legacy npz caches written before the garc format
+    z = np.load(os.path.join(cache, "frag.npz"), allow_pickle=True)
+    fnum = int(z["fnum"])
+    if fnum != comm_spec.fnum:
+        raise ValueError(
+            f"serialized fnum={fnum} != requested {comm_spec.fnum}"
+        )
+    vp = int(z["vp"])
+    directed = bool(z["directed"])
+    weighted = bool(z["weighted"])
+
+    vm = _rebuild_vertex_map(
+        [z[f"oids_{f}"] for f in range(fnum)], fnum, vp, spec
+    )
 
     def csr_of(side, f):
         return CSR(

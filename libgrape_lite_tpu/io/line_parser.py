@@ -29,19 +29,78 @@ class TSVLineParser:
         return _parse_columns(data, 1, 1)
 
 
+# chunked-parallel fallback threshold: below this a single parse wins
+_PAR_MIN_BYTES = 32 << 20
+
+
+def _parse_columns_parallel(data: bytes, int_cols: int, want_cols: int):
+    """Host-pool form of the native loader's thread-chunk parse
+    (`native/loader.cc`; reference `grape/io/local_io_adaptor.cc`
+    partial reads): split on line boundaries, one pool task per chunk
+    (pandas' C engine releases the GIL, so chunks parse concurrently),
+    concatenate columns."""
+    import os
+
+    from libgrape_lite_tpu.utils.thread_pool import ThreadPool
+
+    nt = min(os.cpu_count() or 1, 8)
+    if nt <= 1 or len(data) < _PAR_MIN_BYTES:
+        return _parse_columns(data, int_cols, want_cols)
+    step = len(data) // nt
+    bounds = [0]
+    for i in range(1, nt):
+        cut = data.find(b"\n", i * step)
+        bounds.append(len(data) if cut < 0 else cut + 1)
+    bounds.append(len(data))
+    chunks = [
+        data[a:b] for a, b in zip(bounds, bounds[1:]) if b > a
+    ]
+    pool = ThreadPool(len(chunks))
+    try:
+        parts = pool.for_each(
+            lambda c: _parse_columns(c, int_cols, want_cols), chunks
+        )
+    finally:
+        pool.shutdown()
+    parts = [p for p in parts if p and len(p[0])]
+    if not parts:
+        return _parse_columns(b"", int_cols, want_cols)
+    # a chunk of all-2-field lines in a weighted file yields fewer
+    # columns; pad with NaN (the single-parse semantics) rather than
+    # silently dropping the column file-wide
+    ncol = max(len(p) for p in parts)
+    padded = [
+        list(p) + [
+            np.full(len(p[0]), np.nan) for _ in range(ncol - len(p))
+        ]
+        for p in parts
+    ]
+    return [
+        np.concatenate([p[i] for p in padded]) for i in range(ncol)
+    ]
+
+
 def _parse_columns(data: bytes, int_cols: int, want_cols: int):
     """Parse whitespace table; the first `int_cols` columns keep full
     int64 precision (oids above 2^53 must not round-trip through
     float64 — the reference parses oids as integers,
     `tsv_line_parser.h`)."""
     if _pd is not None:
-        df = _pd.read_csv(
-            _io.BytesIO(data),
-            sep=r"\s+",
-            header=None,
-            comment="#",
-            engine="c",
-        )
+        try:
+            df = _pd.read_csv(
+                _io.BytesIO(data),
+                sep=r"\s+",
+                header=None,
+                comment="#",
+                engine="c",
+            )
+        except _pd.errors.EmptyDataError:
+            # nothing but comments/blank lines in this (chunk of the)
+            # file — yield well-typed empty columns
+            return [
+                np.zeros(0, np.int64 if i < int_cols else np.float64)
+                for i in range(want_cols)
+            ]
         cols = []
         for i in range(min(want_cols, df.shape[1])):
             c = df.iloc[:, i].to_numpy()
@@ -104,7 +163,7 @@ def read_vertex_file(path: str, string_id: bool = False) -> np.ndarray:
         data = f.read()
     if string_id:
         return _parse_string_table(data, 1, False)[0]
-    return _parse_columns(data, 1, 1)[0]
+    return _parse_columns_parallel(data, 1, 1)[0]
 
 
 def read_edge_file(path: str, weighted: bool, string_id: bool = False):
@@ -127,7 +186,7 @@ def read_edge_file(path: str, weighted: bool, string_id: bool = False):
         return nat
     with open(path, "rb") as f:
         data = f.read()
-    cols = _parse_columns(data, 2, 3 if weighted else 2)
+    cols = _parse_columns_parallel(data, 2, 3 if weighted else 2)
     src, dst = cols[0], cols[1]
     w = cols[2] if (weighted and len(cols) > 2) else None
     return src, dst, w

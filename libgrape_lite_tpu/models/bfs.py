@@ -37,13 +37,10 @@ class BFS(ParallelAppBase):
             depth[pid // frag.vp, pid % frag.vp] = 0
         state = {"depth": depth}
         eph_entries = {}
-        self._mx = None
-        if os.environ.get("GRAPE_EXCHANGE") == "mirror" and frag.fnum > 1:
-            from libgrape_lite_tpu.parallel.mirror import (
-                build_mirror_plan,
-            )
+        from libgrape_lite_tpu.parallel.mirror import resolve_mirror_plan
 
-            self._mx = build_mirror_plan(frag, "ie")
+        self._mx = resolve_mirror_plan(frag, "ie")
+        if self._mx is not None:
             eph_entries.update(self._mx.state_entries("mx_"))
         self._mx_uid = self._mx.uid if self._mx is not None else -1
         # pack-gather min pull (GRAPE_SPMV=pack): unit-weight tropical

@@ -78,15 +78,12 @@ class PageRank(BatchShuffleAppBase):
         self._spmv_mode = os.environ.get("GRAPE_SPMV", "auto")
         self._pack = None
         eph_entries = {}
-        # mirror-compressed exchange (GRAPE_EXCHANGE=mirror): sync only
+        # mirror-compressed exchange (GRAPE_EXCHANGE): sync only
         # outer-vertex rows instead of all_gathering the full state
-        self._mx = None
-        if os.environ.get("GRAPE_EXCHANGE") == "mirror" and frag.fnum > 1:
-            from libgrape_lite_tpu.parallel.mirror import (
-                build_mirror_plan,
-            )
+        from libgrape_lite_tpu.parallel.mirror import resolve_mirror_plan
 
-            self._mx = build_mirror_plan(frag, "ie")
+        self._mx = resolve_mirror_plan(frag, "ie")
+        if self._mx is not None:
             eph_entries.update(self._mx.state_entries("mx_"))
         self._mx_uid = self._mx.uid if self._mx is not None else -1
         if self._spmv_mode == "pack":

@@ -37,17 +37,16 @@ class WCC(ParallelAppBase):
         eph_entries = {}
         # mirror-compressed exchange (GRAPE_EXCHANGE=mirror), per pull
         # direction
-        self._mx_ie = self._mx_oe = None
-        if os.environ.get("GRAPE_EXCHANGE") == "mirror" and frag.fnum > 1:
-            from libgrape_lite_tpu.parallel.mirror import (
-                build_mirror_plan,
-            )
+        from libgrape_lite_tpu.parallel.mirror import resolve_mirror_plan
 
-            self._mx_ie = build_mirror_plan(frag, "ie")
+        self._mx_ie = self._mx_oe = None
+        self._mx_ie = resolve_mirror_plan(frag, "ie")
+        if self._mx_ie is not None:
             eph_entries.update(self._mx_ie.state_entries("mx_ie_"))
             if frag.directed:
-                self._mx_oe = build_mirror_plan(frag, "oe")
-                eph_entries.update(self._mx_oe.state_entries("mx_oe_"))
+                self._mx_oe = resolve_mirror_plan(frag, "oe")
+                if self._mx_oe is not None:
+                    eph_entries.update(self._mx_oe.state_entries("mx_oe_"))
         self._mx_uid = self._mx_ie.uid if self._mx_ie is not None else -1
         # pack-gather min pull (GRAPE_SPMV=pack): the label space must
         # stay exactly representable in f32 (labels are pids < 2^24)

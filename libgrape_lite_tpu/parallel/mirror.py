@@ -74,6 +74,43 @@ class MirrorPlan:
 
 _FRAG_MIRROR_CACHE = None
 
+# auto-mode engagement gate: mirror must at least halve the per-round
+# ICI bytes AND the all_gather it replaces must be big enough for bytes
+# (not collective latency) to dominate.  Below ~1 MiB of gathered state
+# an all_gather is latency-bound and the extra gather + all_to_all hop
+# of the mirror path buys nothing (decision recorded in
+# docs/PERF_NOTES.md; revisit with a measured TPU crossover).
+_AUTO_RATIO = 0.5
+_AUTO_MIN_BYTES = 1 << 20
+
+
+def resolve_mirror_plan(frag, direction: str = "ie"):
+    """Resolve the exchange mode for an app's pull (the single entry
+    point models call).  `GRAPE_EXCHANGE`:
+
+      * "mirror" — always exchange mirrors (fnum > 1),
+      * "gather" / "off" — always all_gather,
+      * unset / "auto" — engage mirrors only when the static bytes
+        model shows a clear ICI win (see _AUTO_RATIO/_AUTO_MIN_BYTES).
+
+    Returns a MirrorPlan or None (= use gather_state)."""
+    import os
+
+    mode = os.environ.get("GRAPE_EXCHANGE", "auto") or "auto"
+    if mode in ("gather", "off") or frag.fnum == 1:
+        return None
+    if mode != "mirror" and frag.fnum * frag.vp * 4 <= _AUTO_MIN_BYTES:
+        return None  # too small for bytes to matter; skip the planner
+    plan = build_mirror_plan(frag, direction)
+    if plan is None or mode == "mirror":
+        return plan
+    if (
+        plan.bytes_all_gather > _AUTO_MIN_BYTES
+        and plan.bytes_mirror <= _AUTO_RATIO * plan.bytes_all_gather
+    ):
+        return plan
+    return None
+
 
 def build_mirror_plan(frag, direction: str = "ie") -> MirrorPlan | None:
     """Build (and cache per fragment) the mirror plan for `frag`'s

@@ -95,6 +95,30 @@ def test_mirror_bytes_win(graph_cache):
     assert plan.bytes_mirror < plan.bytes_all_gather
 
 
+def test_mirror_auto_gate(monkeypatch, graph_cache):
+    """Default (auto) engages mirrors only on a clear ICI-bytes win at
+    a size where bytes dominate; env forces override both ways."""
+    import libgrape_lite_tpu.parallel.mirror as mx
+
+    frag = _rand_frag(2, n=400, e=2000, seed=7)
+    monkeypatch.delenv("GRAPE_EXCHANGE", raising=False)
+    assert mx.resolve_mirror_plan(frag) is None  # too small for auto
+    monkeypatch.setenv("GRAPE_EXCHANGE", "mirror")
+    assert mx.resolve_mirror_plan(frag) is not None
+    monkeypatch.setenv("GRAPE_EXCHANGE", "gather")
+    assert mx.resolve_mirror_plan(frag) is None
+
+    # with the size floor lifted, auto's decision must track the
+    # bytes model exactly
+    monkeypatch.delenv("GRAPE_EXCHANGE", raising=False)
+    monkeypatch.setattr(mx, "_AUTO_MIN_BYTES", 0)
+    p2p = graph_cache(8)
+    plan = mx.build_mirror_plan(p2p, "ie")
+    got = mx.resolve_mirror_plan(p2p, "ie")
+    want = plan.bytes_mirror <= mx._AUTO_RATIO * plan.bytes_all_gather
+    assert (got is not None) == want
+
+
 # ---- golden matrix lanes (p2p-31, the reference app_tests goldens) ----
 
 

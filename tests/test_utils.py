@@ -52,10 +52,8 @@ def test_archive_roundtrip():
     assert oa.empty()
 
 
-def test_bitset_and_vertex_set():
+def test_bitset():
     from libgrape_lite_tpu.utils.bitset import Bitset
-    from libgrape_lite_tpu.utils.vertex_array import VertexRange
-    from libgrape_lite_tpu.utils.vertex_set import DenseVertexSet
 
     bs = Bitset(200)
     bs.set_bit(np.array([0, 63, 64, 199]))
@@ -66,14 +64,37 @@ def test_bitset_and_vertex_set():
     bs.reset_bit(np.array([63]))
     assert bs.count() == 3
 
-    vs = DenseVertexSet(VertexRange(100, 300))
-    vs.insert(np.array([100, 150, 299]))
-    assert vs.count() == 3
-    assert vs.exist(np.array([150]))[0]
-    assert not vs.partial_empty(100, 160)
-    assert vs.partial_empty(160, 299)
-    mask = vs.as_mask()
-    assert mask.sum() == 3 and mask[0] and mask[50] and mask[199]
+
+def test_parallel_parse_matches_serial(monkeypatch):
+    """Chunked ThreadPool parse == single parse, including comment-only
+    chunks and mixed 2/3-field lines (weight column NaN-padded)."""
+    import os
+
+    import libgrape_lite_tpu.io.line_parser as lp
+
+    rng = np.random.default_rng(3)
+    lines = ["# leading comment"]
+    for _ in range(4000):
+        lines.append(
+            f"{rng.integers(0, 1000)} {rng.integers(0, 1000)} "
+            f"{rng.random():.6f}"
+        )
+    # a comment-only run big enough to own whole chunks (used to raise
+    # EmptyDataError through the pool)
+    lines.extend(["# pad"] * 3000)
+    data = ("\n".join(lines) + "\n").encode()
+
+    serial = lp._parse_columns(data, 2, 3)
+    monkeypatch.setattr(lp, "_PAR_MIN_BYTES", 1)
+    monkeypatch.setattr(os, "cpu_count", lambda: 6)
+    par = lp._parse_columns_parallel(data, 2, 3)
+    assert len(par) == len(serial)
+    for s, p in zip(serial, par):
+        np.testing.assert_array_equal(p, s)
+
+    # an all-comment file parses to well-typed empty columns
+    empty = lp._parse_columns(b"# a\n# b\n", 2, 3)
+    assert [len(c) for c in empty] == [0, 0, 0]
 
 
 def test_id_parser_bit_layout():
