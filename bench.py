@@ -1,10 +1,11 @@
-"""Benchmark driver: PageRank throughput on one TPU chip.
+"""Benchmark driver: PageRank + SSSP throughput on one TPU chip.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-
-Metric: PageRank MTEPS/chip (edges traversed per second across the 10
-pull rounds, symmetrised edge count), on an RMAT-style power-law graph.
+Prints ONE JSON line.  Primary metric: PageRank MTEPS/chip (edges
+traversed per second across the 10 pull rounds, symmetrised edge
+count) on an RMAT-style power-law graph.  The same line carries the
+second north star as a nested object under "sssp" (VERDICT r3 next
+#5): SSSP MTEPS/chip = single-pass edge count / query wall-clock on
+the same graph with uniform(0.1,10) weights.
 
 The bench A/Bs the SpMV backends ITSELF (VERDICT r2 weak #1: the pack
 pipeline must never hide behind an env var): on a live TPU it measures
@@ -12,13 +13,22 @@ both the XLA gather+segment_sum path and the pack-gather Pallas path,
 reports the best honest number, and says which path won in the metric
 name.  On the CPU fallback (dead tunnel) only the XLA path is timed —
 interpret-mode Pallas at RMAT-20 is not a measurement — and the metric
-says `_cpu_fallback`.  Set GRAPE_SPMV=xla|pack to pin one path;
-GRAPE_BENCH_SCALE to shrink the graph for smoke runs.
+says `_cpu_fallback`.  Env knobs:
+  GRAPE_SPMV=xla|pack          pin one backend
+  GRAPE_BENCH_SCALE=N          RMAT scale (default 20)
+  GRAPE_BENCH_ASSUME_ALIVE=1   skip the probe AND trust the backend
+                               (enables the pack A/B without probing)
+  GRAPE_BENCH_NO_PROBE=1       skip the probe and assume DEAD (CPU
+                               fallback, XLA only — the safe default
+                               for probe-less smoke runs)
 
 Baseline derivation (BASELINE.md): the reference GPU backend runs
 PageRank on soc-LiveJournal1 (68.99M directed edges) in 24.65 ms on
 8× V100 (`Performance.md:94`), i.e. 68.99e6 * 10 rounds / 0.02465 s
-/ 8 chips ≈ 3500 MTEPS per chip.  vs_baseline = our MTEPS/chip / 3500.
+/ 8 chips ≈ 3500 MTEPS per chip.  SSSP: 32.3 ms on the same graph
+(`Performance.md:82`) ≈ 68.99e6 / 0.0323 / 8 ≈ 267 MTEPS per chip
+(single-pass convention — SSSP round counts are graph-dependent, so
+TEPS counts each edge once per query).  vs_baseline = ours / theirs.
 """
 
 from __future__ import annotations
@@ -32,6 +42,7 @@ import numpy as np
 
 
 BASELINE_MTEPS_PER_CHIP = 3500.0
+SSSP_BASELINE_MTEPS_PER_CHIP = 267.0
 SCALE = int(os.environ.get("GRAPE_BENCH_SCALE", 20))  # 2^20 vertices
 EDGE_FACTOR = 16
 
@@ -77,7 +88,15 @@ def main():
     # the axon plugin registers through sitecustomize and initializes
     # even under JAX_PLATFORMS=cpu, so an env check cannot detect the
     # tunnel — and a dead tunnel hangs backend init uninterruptibly.
-    alive = bool(os.environ.get("GRAPE_BENCH_NO_PROBE")) or _backend_alive()
+    # "skip the probe" and "backend known alive" are distinct requests
+    # (ADVICE r3): NO_PROBE alone must not enable interpret-mode pack
+    # on a dead backend.
+    if os.environ.get("GRAPE_BENCH_ASSUME_ALIVE"):
+        alive = True
+    elif os.environ.get("GRAPE_BENCH_NO_PROBE"):
+        alive = False
+    else:
+        alive = _backend_alive()
     if not alive:
         # default backend unreachable: measure on CPU and say so
         import jax
@@ -118,39 +137,43 @@ def main():
 
     rounds = 10
 
-    def measure(mode: str):
-        """Time PageRank with the given SpMV backend pinned; returns
+    def measure(name: str, mode: str, app_factory, bench_frag, kwargs):
+        """Time one app with the given SpMV backend pinned; returns
         (best seconds, engaged backend name) or None on failure."""
         prev = os.environ.get("GRAPE_SPMV")
         os.environ["GRAPE_SPMV"] = mode
         try:
-            app = PageRank(delta=0.85, max_round=rounds)
-            worker = Worker(app, frag)
+            app = app_factory()
+            worker = Worker(app, bench_frag)
             t_c0 = time.perf_counter()
-            worker.query(max_round=rounds)  # warmup (compile + plan)
+            worker.query(**kwargs)  # warmup (compile + plan)
             t_compile = time.perf_counter() - t_c0
             engaged = (
                 "pack" if getattr(app, "_pack", None) is not None
                 else "xla"
             )
             if mode == "pack" and engaged != "pack":
-                print(f"[bench] pack requested but not engaged",
+                print(f"[bench] {name}: pack requested but not engaged",
                       file=sys.stderr)
                 return None
             best = float("inf")
             for _ in range(3):
                 t0 = time.perf_counter()
-                worker.query(max_round=rounds)
+                worker.query(**kwargs)
                 best = min(best, time.perf_counter() - t0)
             print(
-                f"[bench] mode={mode} engaged={engaged} "
-                f"best={best:.4f}s warm+compile={t_compile:.1f}s",
+                f"[bench] {name}: mode={mode} engaged={engaged} "
+                f"best={best:.4f}s warm+compile={t_compile:.1f}s "
+                f"rounds={worker.rounds}",
                 file=sys.stderr,
             )
             return best, engaged
         except Exception as e:  # a failed backend must not kill the bench
-            print(f"[bench] mode {mode} failed: {type(e).__name__}: {e}",
-                  file=sys.stderr)
+            print(
+                f"[bench] {name}: mode {mode} failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
             return None
         finally:
             if prev is None:
@@ -168,52 +191,84 @@ def main():
         modes = ["xla", "pack"]
     else:
         modes = ["xla"]
-    results = {}
-    for mode in modes:
-        r = measure(mode)
-        if r is not None:
-            results[mode] = r
-    if not results:
-        raise RuntimeError("no SpMV backend produced a measurement")
-    best_time, winner = min(results.values(), key=lambda r: r[0])
 
+    def ab(name, app_factory, bench_frag, kwargs):
+        results = {}
+        for mode in modes:
+            r = measure(name, mode, app_factory, bench_frag, kwargs)
+            if r is not None:
+                results[mode] = r
+        if not results:
+            return None
+        return min(results.values(), key=lambda r: r[0])
+
+    pr = ab("pagerank", lambda: PageRank(delta=0.85, max_round=rounds),
+            frag, {"max_round": rounds})
+    if pr is None:
+        raise RuntimeError("no SpMV backend produced a measurement")
+    best_time, winner = pr
     mteps = e_sym * rounds / best_time / 1e6
     tag = f"_{winner}" if len(modes) > 1 or forced else ""
-    print(
-        json.dumps(
-            {
-                "metric": f"pagerank_rmat{SCALE}_mteps_per_chip{tag}{suffix}",
-                "value": round(mteps, 1),
-                "unit": "MTEPS/chip",
-                "vs_baseline": round(mteps / BASELINE_MTEPS_PER_CHIP, 3),
-            }
-        )
-    )
+    record = {
+        "metric": f"pagerank_rmat{SCALE}_mteps_per_chip{tag}{suffix}",
+        "value": round(mteps, 1),
+        "unit": "MTEPS/chip",
+        "vs_baseline": round(mteps / BASELINE_MTEPS_PER_CHIP, 3),
+    }
 
-    if os.environ.get("GRAPE_BENCH_FULL"):
-        # side metrics on stderr AFTER the primary line is out — a hang
-        # or failure here must not cost the already-made measurement
-        from libgrape_lite_tpu.models import BFS, CDLP, SSSP, WCC
+    # the primary measurement goes out BEFORE the SSSP lane: a chip
+    # death mid-SSSP (the documented r1/r2 failure mode) hangs
+    # uninterruptibly, and the driver reads the LAST JSON line — so a
+    # completed SSSP lane supersedes this line with the combined record
+    print(json.dumps(record), flush=True)
 
-        print(f"[bench-extra] load: {t_load:.2f}s", file=sys.stderr)
+    # second north star: SSSP on the same graph, weighted (best-effort —
+    # a failure must not cost the PageRank measurement)
+    try:
+        from libgrape_lite_tpu.models import SSSP
 
-        # SSSP (the other BASELINE.json north star) needs weighted edges
         rng_w = np.random.default_rng(11)
-        w = rng_w.uniform(0.1, 10.0, size=len(src))
+        w = rng_w.uniform(0.1, 10.0, size=len(src)).astype(np.float32)
         frag_w = ShardedEdgecutFragment.build(
             comm_spec, vm, src, dst, w,
             directed=False,
             load_strategy=LoadStrategy.kBothOutIn,
         )
+        ss = ab("sssp", SSSP, frag_w, {"source": 0})
+        if ss is not None:
+            ss_time, ss_winner = ss
+            ss_mteps = e_sym / ss_time / 1e6
+            ss_tag = f"_{ss_winner}" if len(modes) > 1 or forced else ""
+            record["sssp"] = {
+                "metric":
+                    f"sssp_rmat{SCALE}_mteps_per_chip{ss_tag}{suffix}",
+                "value": round(ss_mteps, 1),
+                "unit": "MTEPS/chip",
+                "vs_baseline":
+                    round(ss_mteps / SSSP_BASELINE_MTEPS_PER_CHIP, 3),
+            }
+    except Exception as e:
+        print(f"[bench] sssp lane failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    else:
+        if "sssp" in record:
+            print(json.dumps(record), flush=True)
+
+    if os.environ.get("GRAPE_BENCH_FULL"):
+        # side metrics on stderr AFTER the primary line is out — a hang
+        # or failure here must not cost the already-made measurement
+        # (SSSP graduated to the primary record above)
+        from libgrape_lite_tpu.models import BFS, CDLP, WCC
+
+        print(f"[bench-extra] load: {t_load:.2f}s", file=sys.stderr)
 
         for nm, a, kw in (
             ("wcc", WCC(), {}),
             ("bfs", BFS(), {"source": 0}),
             ("cdlp", CDLP(), {"max_round": 10}),
-            ("sssp", SSSP(), {"source": 0}),
         ):
             try:
-                wk = Worker(a, frag_w if nm == "sssp" else frag)
+                wk = Worker(a, frag)
                 wk.query(**kw)  # compile
                 t0 = time.perf_counter()
                 wk.query(**kw)

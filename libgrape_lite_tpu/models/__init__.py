@@ -20,7 +20,7 @@ from libgrape_lite_tpu.models.pagerank import PageRank
 from libgrape_lite_tpu.models.sssp import SSSP
 from libgrape_lite_tpu.models.bfs import BFS
 from libgrape_lite_tpu.models.wcc import WCC
-from libgrape_lite_tpu.models.cdlp import CDLP
+from libgrape_lite_tpu.models.cdlp import CDLP, CDLPOpt
 from libgrape_lite_tpu.models.lcc import LCC
 from libgrape_lite_tpu.models.bc import BC
 from libgrape_lite_tpu.models.kcore import KCore
@@ -67,9 +67,9 @@ APP_REGISTRY = {
     "pagerank_push": PageRankAuto,
     "cdlp": CDLP,
     "cdlp_auto": CDLP,
-    "cdlp_opt": CDLP,
-    "cdlp_opt_ud": CDLP,
-    "cdlp_opt_ud_dense": CDLP,
+    "cdlp_opt": CDLPOpt,
+    "cdlp_opt_ud": CDLPOpt,
+    "cdlp_opt_ud_dense": CDLPOpt,
     # `lcc` = the merge-intersection variant (LCCBeta): measured 6.1s
     # warm vs 10.8s for the bitmap kernel on the p2p-31 CI config
     # (4-dev CPU mesh, scripts/run_ldbc.py, round 2); O(chunk·Dmax)

@@ -155,3 +155,44 @@ class CDLP(ParallelAppBase):
                 lambda x: lut.get(int(x), -1), otypes=[object]
             )(labels)
         return labels
+
+
+class CDLPOpt(CDLP):
+    """CDLP with the reference's first-round shortcut
+    (`cdlp_opt.h:139-162`, `cdlp_opt_ud.h:148-162`): initial labels are
+    all-distinct vertex ids, so "most frequent, ties to smallest"
+    degenerates to a plain neighbor minimum — one O(E) segment_min pull
+    replaces the O(E log E) sort-mode pipeline for round 1.  (Like the
+    reference shortcut, this assumes a simple graph: a parallel edge
+    would give its endpoint's label multiplicity ≥ 2 in round 1 and the
+    true mode could differ from the min.  LDBC inputs are simple.)
+
+    The reference's remaining opt machinery maps as follows (argued in
+    PARITY.md):
+      * sparse change-frontier rounds (`cdlp_opt_ud.h:89-120`,
+        threshold in `cdlp_opt_context.h`) — N/A on TPU: the dense
+        masked formulation recomputes every row at full VPU width
+        regardless, so a sparse frontier saves nothing and costs a
+        gather;
+      * `update_label_fast_{jump,sparse,dense}` per-vertex counting
+        kernels (`cdlp_utils.h`) — scalar-CPU/SIMD concerns; the
+        packed-key sort + run-length encode here IS the vectorized
+        counting kernel;
+      * `ud` (undirected-only load) — the oe==ie aliased CSR already
+        halves storage for undirected graphs (fragment/edgecut.py).
+    Output is bit-identical to CDLP for every round count.
+    """
+
+    def peval(self, ctx: StepContext, frag, state):
+        labels = state["labels"]
+        oe = frag.oe
+        dt = labels.dtype
+        big = jnp.asarray(np.iinfo(np.dtype(dt).name).max, dt)
+        full = ctx.gather_state(labels)
+        cand = jnp.where(oe.edge_mask, full[oe.edge_nbr], big)
+        mn = self.segment_reduce(cand, oe.edge_src, frag.vp, "min")
+        has_out = frag.out_degree > 0
+        keep = jnp.logical_or(~frag.inner_mask, ~has_out)
+        new = jnp.where(jnp.logical_or(keep, mn == big), labels, mn)
+        state = dict(state, labels=new, step=jnp.int32(1))
+        return state, jnp.int32(1 if self.max_round > 1 else 0)

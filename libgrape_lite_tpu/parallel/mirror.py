@@ -97,6 +97,15 @@ def resolve_mirror_plan(frag, direction: str = "ie"):
     import os
 
     mode = os.environ.get("GRAPE_EXCHANGE", "auto") or "auto"
+    if mode not in ("mirror", "gather", "off", "auto"):
+        # an unrecognized value must not silently engage mirrors
+        from libgrape_lite_tpu.utils import logging as glog
+
+        glog.log_info(
+            f"GRAPE_EXCHANGE={mode!r} is not one of "
+            "mirror|gather|off|auto; using gather"
+        )
+        return None
     if mode in ("gather", "off") or frag.fnum == 1:
         return None
     if mode != "mirror" and frag.fnum * frag.vp * 4 <= _AUTO_MIN_BYTES:

@@ -8,9 +8,10 @@ for i in $(seq 1 66); do
     date -u +"%Y-%m-%dT%H:%M:%SZ alive (iter $i)" > /root/repo/.tpu_alive
     exit 0
   fi
-  # reap any orphaned axon warm-up children the probe left behind
-  # (the plugin spawns 'np.asarray((jnp.ones((8,8)).sum()))' helpers)
-  pkill -f 'jnp\.ones' 2>/dev/null
+  # reap any orphaned axon warm-up children the probe left behind —
+  # match the plugin's exact no-space helper text so bench.py's own
+  # live probe ('jnp.ones((8, 8)).sum()...', with spaces) is never hit
+  pkill -f 'np\.asarray\(\(jnp\.ones\(\(8,8\)\)' 2>/dev/null
   echo "$(date -u +%H:%M:%S) iter $i: dead" >> /root/repo/.tpu_watch.log
   sleep 600
 done

@@ -70,3 +70,24 @@ def test_lcc(graph_cache, fnum):
     frag = graph_cache(fnum)
     res = run_worker(LCC(), frag)
     eps_verify(res, load_golden(dataset_path("p2p-31-LCC")))
+
+
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_cdlp_opt(graph_cache, fnum):
+    """CDLPOpt's round-1 min shortcut must stay golden-identical
+    (cdlp_opt.h's PEval exploits all-distinct initial labels)."""
+    from libgrape_lite_tpu.models import CDLPOpt
+
+    frag = graph_cache(fnum)
+    res = run_worker(CDLPOpt(), frag, max_round=10)
+    exact_verify(res, load_golden(dataset_path("p2p-31-CDLP")))
+
+
+def test_cdlp_opt_single_round(graph_cache):
+    """max_round=1 exercises exactly the shortcut round."""
+    from libgrape_lite_tpu.models import CDLP, CDLPOpt
+
+    frag = graph_cache(2)
+    base = run_worker(CDLP(), frag, max_round=1)
+    opt = run_worker(CDLPOpt(), frag, max_round=1)
+    assert base == opt

@@ -16,6 +16,8 @@ from collections import Counter
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from tests.conftest import dataset_path
 from tests.verifiers import collect_worker_result, exact_verify, load_golden
 

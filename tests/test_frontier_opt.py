@@ -6,6 +6,8 @@ work per push round shrinks vs plain Bellman-Ford)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from tests.conftest import dataset_path
 from tests.verifiers import collect_worker_result, exact_verify, load_golden
 

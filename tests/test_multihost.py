@@ -2,6 +2,10 @@
 CommSpec.init_distributed — the reference exercises its multi-process
 story with `mpirun -n N` in CI (`misc/app_tests.sh:231-238`)."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import os
 import subprocess
 import sys

@@ -12,6 +12,8 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from libgrape_lite_tpu.ops.route3 import (
     apply_route3_np,
     plan_route,

@@ -12,6 +12,8 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from libgrape_lite_tpu.ops.spmv_pack import (
     PackConfig,
     exec_plan_np,

@@ -4,6 +4,8 @@ force the overflow-retry path."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from tests.conftest import dataset_path
 from tests.test_apps_golden import run_worker
 from tests.verifiers import exact_verify, load_golden

@@ -4,6 +4,10 @@ configs run x32 and float64 state silently becomes float32.  This test
 runs the core apps in a subprocess with x64 off and checks eps parity.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import os
 import subprocess
 import sys
