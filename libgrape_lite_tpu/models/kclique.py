@@ -10,10 +10,10 @@ engine (`UniFragCliqueNumRecursive`); the irregular recursion has no
 profitable static-shape form, so this app runs on the *host engine*
 (numpy packed bitmaps, vectorised innermost levels) rather than the
 traced superstep path — mirroring where the reference actually executes
-it — except k=3, which runs ON-DEVICE through the merge-intersection
-kernel (models/lcc_beta.py in apex-counting mode).  k>=4 recurses per
-apex on the host with vectorised leaf levels; moving k=4 onto the same
-ELL structure is ROADMAP item 3's remainder.
+it — except k=3 (merge-intersection kernel, models/lcc_beta.py in
+apex-counting mode) and k=4 under `hub_cap` (double-ring ELL kernel,
+models/kclique_device.py), which run ON-DEVICE.  k>=5 and over-cap k=4
+recurse per apex on the host with vectorised leaf levels.
 
 Output: per-apex clique counts (sum == global k-clique count, exposed
 as `worker.app.total_cliques` after a query; the reference prints only
@@ -51,10 +51,13 @@ class KClique(AppBase):
     host_only = True
 
     # k=4 runs on-device (models/kclique_device.py) while the max
-    # oriented out-degree stays under this cap; beyond it the per-edge
-    # [D, D] third-level tensors explode (RMAT hubs: D≈6202 → 38M
-    # entries/edge) and the host recursion takes over
-    hub_cap = 160
+    # oriented out-degree stays under this cap; the kernel's chunking
+    # keeps the [chunk, D, D] third-level tensor under ~2M entries, so
+    # the cap bounds per-edge WORK (D² candidate tests/edge), not
+    # memory.  Low->high orientation keeps D at degeneracy scale
+    # (rmat13/16/18/20 → 66/151/259/679 vs 1508/6202/… hi->lo); 320
+    # admits RMAT-18 on-device, RMAT-20 hubs recurse on host
+    hub_cap = 320
 
     def __init__(self, k: int = 3):
         self.k = k
@@ -205,7 +208,10 @@ def _oriented_pairs(frag):
 
     pairs = np.unique(np.stack([v, u], 1), axis=0)
     v, u = pairs[:, 0], pairs[:, 1]
-    keep = (deg[u] < deg[v]) | ((deg[u] == deg[v]) & (u < v))
+    # low->high orientation (matches KClique4Device's ELL): every clique
+    # is counted at its (degree,id)-minimal member, and max oriented
+    # out-degree is bounded by degeneracy instead of raw hub degree
+    keep = (deg[u] > deg[v]) | ((deg[u] == deg[v]) & (u > v))
     keep &= v != u
     cached = (v[keep], u[keep])
     _ORIENTED_PAIRS[frag] = cached

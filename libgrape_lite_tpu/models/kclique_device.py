@@ -14,12 +14,12 @@ outer ring rotates u's ELL block, the inner ring rotates w's
 (fnum² systolic steps, each a batched searchsorted).
 
 Shapes are static: per edge chunk the third level materialises
-[chunk, D, D] candidate hits, D = the graph's max oriented out-degree
-(bounded by degeneracy).  The kernel is gated by `hub_cap`
-(`models/kclique.py` falls back to the host recursion when D exceeds
-it) — the ROADMAP r1 item 3 hub-cap design: RMAT hubs (D ≈ 6202)
-would need ~38M-entry rows per edge, while LDBC-style graphs
-(p2p-31 D = 95) fit comfortably.
+[chunk, D, D] candidate hits, D = the graph's max oriented out-degree.
+The low->high (degree, id) orientation bounds D by degeneracy scale —
+RMAT hubs keep only their few higher-degree neighbors (rmat16 D = 151
+vs 6202 under high->low), which is what admits power-law graphs to
+this kernel at all.  `hub_cap` (`models/kclique.py`) gates per-edge
+work: beyond it the host recursion takes over (RMAT-20's D = 679).
 """
 
 from __future__ import annotations
@@ -38,6 +38,9 @@ class KClique4Device(LCCBeta):
 
     result_format = "int"
     credit_mode = "apex"
+    # low->high orientation: RMAT hubs keep only their few higher-degree
+    # neighbors, so D stays under hub_cap (rmat16: 151 vs 6202 hi->lo)
+    orientation = "lo"
 
     def init_state(self, frag, **kw):
         state = super().init_state(frag, **kw)
@@ -54,20 +57,9 @@ class KClique4Device(LCCBeta):
         d = ell.shape[-1]
         oe = frag.oe
 
-        # oriented dedup edge mask — same rule as the ELL build
-        from libgrape_lite_tpu.models.lcc import LCC
-
-        deg_local = frag.out_degree
-        deg_full = ctx.gather_state(deg_local)
-        row_pid = my_fid * vp + jnp.minimum(oe.edge_src, vp - 1)
-        d_row = deg_local[jnp.minimum(oe.edge_src, vp - 1)]
-        d_nbr = deg_full[oe.edge_nbr]
-        keep = jnp.logical_or(
-            d_nbr < d_row,
-            jnp.logical_and(d_nbr == d_row, oe.edge_nbr < row_pid),
-        )
-        keep = jnp.logical_and(LCC._dedup_mask(oe), keep)
-        keep = jnp.logical_and(keep, oe.edge_nbr != row_pid)
+        # oriented dedup edge mask — same rule (and orientation) as the
+        # ELL build, via the shared helper
+        keep = self._oriented_edge_mask(ctx, frag)
 
         ep = oe.edge_src.shape[0]
         # [chunk, d, d] third-level tensors bound the chunk size

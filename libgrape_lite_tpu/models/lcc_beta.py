@@ -42,6 +42,10 @@ class LCCBeta(ParallelAppBase):
     # "apex": apex-only triangle counts (each triangle counted once at
     # its DAG apex) — the k=3 clique-counting mode used by KClique.
     credit_mode = "lcc"
+    # DAG orientation for the ELL build: "hi" = edges point to the
+    # lower-(degree,id) endpoint (LCC's convention); "lo" = to the
+    # higher one, bounding max out-degree by degeneracy (k=4 kernel)
+    orientation = "hi"
 
     def init_state(self, frag, degree_threshold: int = 0, **_):
         """Host prep: dedup degree-oriented out-adjacency as sorted,
@@ -71,7 +75,14 @@ class LCCBeta(ParallelAppBase):
             u = c.edge_nbr[:e].astype(np.int64)
             pairs = np.unique(np.stack([v, u], 1), axis=0)
             v, u = pairs[:, 0], pairs[:, 1]
-            keep = (deg[u] < deg[v]) | ((deg[u] == deg[v]) & (u < v))
+            if self.orientation == "lo":
+                # low->high: out-degree bounded by degeneracy (hubs
+                # keep only higher-degree neighbors — few); the k=4
+                # kernel uses this to stay under hub_cap on power-law
+                # graphs
+                keep = (deg[u] > deg[v]) | ((deg[u] == deg[v]) & (u > v))
+            else:
+                keep = (deg[u] < deg[v]) | ((deg[u] == deg[v]) & (u < v))
             keep &= u != v
             if self.degree_threshold > 0:
                 keep &= deg[v] <= self.degree_threshold
@@ -99,6 +110,38 @@ class LCCBeta(ParallelAppBase):
             "lcc": np.zeros((fnum, vp), dtype=np.float64),
         }
 
+    def _oriented_edge_mask(self, ctx, frag):
+        """Traced oriented-dedup edge mask over frag.oe — the SAME rule
+        as the host ELL build, honoring `self.orientation` (shared by
+        the LCC pass and the k=4 kernel so the two can never drift)."""
+        from libgrape_lite_tpu.models.lcc import LCC
+
+        vp = frag.vp
+        my_fid = lax.axis_index(FRAG_AXIS).astype(jnp.int32)
+        oe = frag.oe
+        deg_local = frag.out_degree
+        deg_full = ctx.gather_state(deg_local)
+        row_pid = my_fid * vp + jnp.minimum(oe.edge_src, vp - 1)
+        d_row = deg_local[jnp.minimum(oe.edge_src, vp - 1)]
+        d_nbr = deg_full[oe.edge_nbr]
+        if self.orientation == "lo":
+            keep = jnp.logical_or(
+                d_nbr > d_row,
+                jnp.logical_and(d_nbr == d_row, oe.edge_nbr > row_pid),
+            )
+        else:
+            keep = jnp.logical_or(
+                d_nbr < d_row,
+                jnp.logical_and(d_nbr == d_row, oe.edge_nbr < row_pid),
+            )
+        keep = jnp.logical_and(LCC._dedup_mask(oe), keep)
+        keep = jnp.logical_and(keep, oe.edge_nbr != row_pid)
+        if self.degree_threshold > 0:
+            # filtered v enumerates no oriented edges; a filtered middle
+            # u's ELL row is already empty (host build dropped it)
+            keep = jnp.logical_and(keep, d_row <= self.degree_threshold)
+        return keep
+
     def peval(self, ctx: StepContext, frag, state):
         vp, fnum = frag.vp, frag.fnum
         n_pad = vp * fnum
@@ -108,24 +151,7 @@ class LCCBeta(ParallelAppBase):
         d = ell.shape[-1]
         oe = frag.oe
 
-        # oriented dedup edge mask (same rule as the ELL build)
-        from libgrape_lite_tpu.models.lcc import LCC
-
-        deg_local = frag.out_degree
-        deg_full = ctx.gather_state(deg_local)
-        row_pid = my_fid * vp + jnp.minimum(oe.edge_src, vp - 1)
-        d_row = deg_local[jnp.minimum(oe.edge_src, vp - 1)]
-        d_nbr = deg_full[oe.edge_nbr]
-        keep = jnp.logical_or(
-            d_nbr < d_row,
-            jnp.logical_and(d_nbr == d_row, oe.edge_nbr < row_pid),
-        )
-        keep = jnp.logical_and(LCC._dedup_mask(oe), keep)
-        keep = jnp.logical_and(keep, oe.edge_nbr != row_pid)
-        if self.degree_threshold > 0:
-            # filtered v enumerates no oriented edges; a filtered middle
-            # u's ELL row is already empty (host build dropped it)
-            keep = jnp.logical_and(keep, d_row <= self.degree_threshold)
+        keep = self._oriented_edge_mask(ctx, frag)
 
         ep = oe.edge_src.shape[0]
         # chunk size bounded so chunk*d stays ~4M int32 entries
@@ -207,6 +233,7 @@ class LCCBeta(ParallelAppBase):
             out = jnp.where(frag.inner_mask, tri, 0).astype(jnp.int32)
             return dict(state, tri=out), jnp.int32(0)
         dt = state["lcc"].dtype
+        deg_local = frag.out_degree
         degf = deg_local.astype(dt)
         denom = degf * (degf - 1)
         lcc = jnp.where(
