@@ -252,9 +252,13 @@ class LCCBeta(ParallelAppBase):
 
 class ApexTriangleCount(LCCBeta):
     """k=3 clique counting: the merge kernel in apex-only credit mode
-    with integer counts (used by models/kclique.py)."""
+    with integer counts (used by models/kclique.py).  Uses the same
+    low->high orientation as the k=4 kernel and the host recursion, so
+    per-apex attribution is consistent across every k (each clique
+    credits its (degree, id)-minimal member)."""
 
     credit_mode = "apex"
+    orientation = "lo"
     result_format = "int"
 
     def init_state(self, frag, **kw):

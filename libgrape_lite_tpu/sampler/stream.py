@@ -79,20 +79,33 @@ class KafkaSink:  # pragma: no cover - requires kafka runtime
 
 
 def run_pipeline(fragment, sampler, source: Iterable[str], sink,
-                 fanouts=(10, 5), batch: int = 512) -> int:
+                 fanouts=(10, 5), batch: int = 512,
+                 directed: bool = False, seed: int = 0) -> int:
     """The run_sampler.cc loop: drain updates/queries, extend the
-    append-only fragment, batch-sample, emit `vid: n1 n2 ...` lines."""
+    append-only fragment, batch-sample, emit `vid: n1 n2 ...` lines.
+
+    `directed=False` (the reference's graph_spec, run_sampler.cc:78)
+    inserts each update in both directions; an `e src dst [w]` line
+    therefore means ONE undirected edge — a stream that already
+    carries both orientations of each edge should pass directed=True
+    (there is no dedup downstream).  Each query batch draws from a
+    fresh fold of `seed` so re-queried vertices get independent
+    samples."""
     import numpy as np
 
     queries: list[int] = []
     emitted = 0
+    batch_no = 0
 
     def flush_queries():
-        nonlocal emitted
+        nonlocal emitted, batch_no
         if not queries:
             return
         fragment.flush()
-        hops = sampler.sample(np.asarray(queries), fanouts)
+        hops = sampler.sample(
+            np.asarray(queries), fanouts, seed=seed + batch_no
+        )
+        batch_no += 1
         for i, q in enumerate(queries):
             flat = [str(x) for h in hops for x in h[i].tolist() if x >= 0]
             sink.emit(f"{q}: {' '.join(flat)}")
@@ -104,10 +117,13 @@ def run_pipeline(fragment, sampler, source: Iterable[str], sink,
         if not parts:
             continue
         if parts[0] == "e":
-            fragment.extend(
-                [int(parts[1])], [int(parts[2])],
-                [float(parts[3])] if len(parts) > 3 else None,
-            )
+            s, d = int(parts[1]), int(parts[2])
+            w = [float(parts[3])] if len(parts) > 3 else None
+            if directed:
+                fragment.extend([s], [d], w)
+            else:
+                fragment.extend([s, d], [d, s], None if w is None
+                                else w * 2)
         elif parts[0] == "q":
             queries.append(int(parts[1]))
             if len(queries) >= batch:

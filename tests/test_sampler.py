@@ -1,6 +1,12 @@
 """GNN sampler tests (analogue of `misc/sampler_test.sh`)."""
 
+import os
+
 import numpy as np
+
+ROOT_SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
 import pytest
 
 
@@ -94,3 +100,48 @@ def test_streaming_pipeline(tmp_path):
     assert samples0 <= {"1", "2"}
     # vertex 7 unknown at query time: grows the id space, no neighbors
     assert lines[2].strip() == "7:"
+
+
+def test_run_sampler_driver(tmp_path):
+    """scripts/run_sampler.py end to end, both modes (parity with
+    run_sampler.cc + misc/sampler_test.sh)."""
+    import sys
+
+    sys.path.insert(0, str(ROOT_SCRIPTS))
+    import run_sampler as drv
+
+    e = tmp_path / "g.e"
+    v = tmp_path / "g.v"
+    v.write_text("".join(f"{i}\n" for i in range(8)))
+    e.write_text("0 1 1.0\n0 2 2.0\n1 2 1.0\n2 3 4.0\n4 5 1.0\n")
+
+    # static mode: every vertex sampled once
+    out = tmp_path / "static"
+    rc = drv.main([
+        "--efile", str(e), "--vfile", str(v), "--weighted",
+        "--sampling_strategy", "top_k", "--hop_and_num", "2-2",
+        "--out_prefix", str(out),
+    ])
+    assert rc == 0
+    lines = (out / "result_frag_0").read_text().strip().splitlines()
+    assert len(lines) == 8
+    got = dict(ln.split(":", 1) for ln in lines)
+    # deterministic top_k: 0's two heaviest neighbors are 2 (w=2) then 1
+    assert got["0"].split()[:2] == ["2", "1"]
+    assert got["7"].strip() == ""  # isolated vertex -> empty list
+
+    # streaming mode: updates become sampleable, undirected both ways
+    stream = tmp_path / "in.txt"
+    stream.write_text("q 6\ne 6 7 3.0\nq 6\nq 7\n")
+    sout = tmp_path / "out.txt"
+    rc = drv.main([
+        "--efile", str(e), "--vfile", str(v), "--weighted",
+        "--sampling_strategy", "top_k", "--hop_and_num", "1",
+        "--input_stream", str(stream), "--output_stream", str(sout),
+        "--batch", "1",
+    ])
+    assert rc == 0
+    slines = sout.read_text().strip().splitlines()
+    assert slines[0].strip() == "6:"        # before the update
+    assert slines[1].strip() == "6: 7"      # after e 6 7
+    assert slines[2].strip() == "7: 6"      # reverse direction too
