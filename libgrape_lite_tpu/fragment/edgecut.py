@@ -380,8 +380,10 @@ class ShardedEdgecutFragment:
 
         shard = comm_spec.sharded()
 
+        from libgrape_lite_tpu.parallel.comm_spec import put_global
+
         def put(x):
-            return None if x is None else jax.device_put(jnp.asarray(x), shard)
+            return put_global(x, shard)
 
         oe_dev = DeviceCSR(
             put(oe_h.indptr), put(oe_h.edge_src), put(oe_h.edge_nbr),
@@ -397,7 +399,7 @@ class ShardedEdgecutFragment:
         )
         out_deg_dev = put(out_degree)
         frag = DeviceFragment(
-            ivnum=jax.device_put(jnp.asarray(ivnum), shard),
+            ivnum=put_global(ivnum, shard),
             inner_mask=put(inner_mask),
             oids=put(oids),
             oe=oe_dev,

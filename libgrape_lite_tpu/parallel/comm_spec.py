@@ -20,6 +20,25 @@ VC_COL_AXIS = "vccol"
 kCoordinatorRank = 0  # reference grape/config.h:64
 
 
+def put_global(x, sharding: NamedSharding):
+    """`jax.device_put` honoring multi-process meshes: when the
+    sharding spans non-addressable devices (a jax.distributed run),
+    assemble the global array from this process's full host copy via
+    `make_array_from_callback` — every process loads identical arrays
+    (deterministic loader), the multi-host form of the reference's
+    per-rank loading contract.  Single-process: plain device_put."""
+    if x is None:
+        return None
+    if sharding.is_fully_addressable:
+        import jax.numpy as jnp
+
+        return jax.device_put(jnp.asarray(x), sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
 class CommSpec:
     @classmethod
     def init_distributed(cls, coordinator_address: str | None = None,

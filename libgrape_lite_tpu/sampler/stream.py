@@ -117,6 +117,9 @@ def run_pipeline(fragment, sampler, source: Iterable[str], sink,
         if not parts:
             continue
         if parts[0] == "e":
+            # arrival order is the contract: queries already queued must
+            # sample the PRE-update graph
+            flush_queries()
             s, d = int(parts[1]), int(parts[2])
             w = [float(parts[3])] if len(parts) > 3 else None
             if directed:

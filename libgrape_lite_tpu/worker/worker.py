@@ -206,13 +206,16 @@ class Worker:
         return out_state
 
     def _place_state(self, state_np):
-        """device_put the init state: sharded leaves over the frag axis,
+        """Place the init state: sharded leaves over the frag axis,
         declared-replicated leaves everywhere, custom-spec leaves per
-        their declared PartitionSpec."""
+        their declared PartitionSpec.  Multi-process meshes go through
+        `put_global` (every process holds the same host arrays)."""
+        from libgrape_lite_tpu.parallel.comm_spec import put_global
+
         mesh, _ = self._mesh_layout()
         specs, _ = self._key_specs(state_np)
         return {
-            k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, specs[k]))
+            k: put_global(v, NamedSharding(mesh, specs[k]))
             for k, v in state_np.items()
         }
 

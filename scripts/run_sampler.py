@@ -46,6 +46,10 @@ def parse_args(argv=None):
                         "flags.h:27, e.g. 4-5)")
     p.add_argument("--weighted", action="store_true",
                    help="efile has a weight column")
+    p.add_argument("--directed", action="store_true",
+                   help="stream updates are directed edges (pass this "
+                        "when the stream already carries both "
+                        "orientations — there is no dedup downstream)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--batch", type=int, default=512,
                    help="streaming query batch size (reference "
@@ -128,6 +132,7 @@ def main(argv=None) -> int:
             emitted = run_pipeline(
                 frag, sampler, source, sink, fanouts=fanouts,
                 batch=args.batch, seed=args.seed,
+                directed=args.directed,
             )
         sink.close()
         print(f"[run_sampler] emitted {emitted} samples; "
@@ -135,19 +140,20 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 0
 
-    # static mode (sampler_test.sh): sample every vertex once
-    queries = oids.astype(np.int64)
-    with phase("sample"):
-        hops = sampler.sample(queries, fanouts, seed=args.seed)
+    # static mode (sampler_test.sh): sample every vertex once — the
+    # same pipeline, fed a synthetic all-vertices query stream, so both
+    # modes share one emit/format/batching path
     os.makedirs(args.out_prefix or ".", exist_ok=True)
     out_path = os.path.join(args.out_prefix or ".", "result_frag_0")
-    with open(out_path, "w") as f:
-        for i, q in enumerate(queries.tolist()):
-            flat = [
-                str(x) for h in hops for x in h[i].tolist() if x >= 0
-            ]
-            f.write(f"{q}: {' '.join(flat)}\n")
-    print(f"[run_sampler] wrote {len(queries)} lines to {out_path}",
+    sink = FileSink(out_path)
+    with phase("sample"):
+        emitted = run_pipeline(
+            frag, sampler,
+            (f"q {o}" for o in oids.tolist()),
+            sink, fanouts=fanouts, batch=args.batch, seed=args.seed,
+        )
+    sink.close()
+    print(f"[run_sampler] wrote {emitted} lines to {out_path}",
           file=sys.stderr)
     return 0
 

@@ -102,12 +102,10 @@ def test_streaming_pipeline(tmp_path):
     assert lines[2].strip() == "7:"
 
 
-def test_run_sampler_driver(tmp_path):
+def test_run_sampler_driver(tmp_path, monkeypatch):
     """scripts/run_sampler.py end to end, both modes (parity with
     run_sampler.cc + misc/sampler_test.sh)."""
-    import sys
-
-    sys.path.insert(0, str(ROOT_SCRIPTS))
+    monkeypatch.syspath_prepend(str(ROOT_SCRIPTS))
     import run_sampler as drv
 
     e = tmp_path / "g.e"
