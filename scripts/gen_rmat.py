@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Generate an RMAT edge file for scale runs (the LDBC datagen stand-in
+for this sandbox; reference scope `/root/reference/Performance.md:21-50`).
+
+  python scripts/gen_rmat.py --scale 24 --edge_factor 16 \
+      --weighted --out /tmp/rmat24.e
+
+Writes `src dst [w]` lines (integer weights 1..10 so the pandas C
+writer stays fast); chunked so peak memory stays ~2 GB regardless of
+scale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", type=int, default=24)
+    p.add_argument("--edge_factor", type=int, default=16)
+    p.add_argument("--weighted", action="store_true")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--out", required=True)
+    args = p.parse_args(argv)
+
+    from bench import rmat_edges
+
+    t0 = time.perf_counter()
+    n, src, dst = rmat_edges(args.scale, args.edge_factor, args.seed)
+    print(f"[gen_rmat] generated {len(src):,} edges over {n:,} vertices "
+          f"in {time.perf_counter() - t0:.1f}s", flush=True)
+
+    import pandas as pd
+
+    rng = np.random.default_rng(args.seed + 1)
+    t0 = time.perf_counter()
+    chunk = 1 << 24
+    with open(args.out, "w") as f:
+        for lo in range(0, len(src), chunk):
+            hi = min(lo + chunk, len(src))
+            cols = {"s": src[lo:hi], "d": dst[lo:hi]}
+            if args.weighted:
+                cols["w"] = rng.integers(1, 11, hi - lo)
+            pd.DataFrame(cols).to_csv(
+                f, sep=" ", header=False, index=False
+            )
+    print(f"[gen_rmat] wrote {args.out} "
+          f"({os.path.getsize(args.out) / (1 << 30):.2f} GiB) in "
+          f"{time.perf_counter() - t0:.1f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

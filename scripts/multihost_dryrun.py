@@ -117,8 +117,7 @@ def worker(pid: int, coord: str) -> None:
     )
     app = PageRank()
     wk = Worker(app, frag)
-    wk.query(delta=0.85, max_round=10)
-    rank = wk._result_state["rank"]
+    rank = wk.query(delta=0.85, max_round=10)["rank"]
 
     golden = {}
     with open(os.path.join(REPO, "dataset", "p2p-31-PR")) as f:
