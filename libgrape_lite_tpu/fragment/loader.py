@@ -57,7 +57,14 @@ def _cache_dir(efile: str, vfile: str, spec: LoadGraphSpec, fnum: int) -> str:
             "vsize": os.path.getsize(vfile) if vfile else 0,
             "directed": spec.directed,
             "weighted": spec.weighted,
-            "strategy": spec.load_strategy.value,
+            # undirected fragments alias oe == ie (one symmetrised CSR
+            # serves every strategy), so apps with different
+            # load_strategy traits share one cache entry — a PageRank
+            # --serialize feeds an SSSP --deserialize
+            "strategy": (
+                "undirected-aliased" if not spec.directed
+                else spec.load_strategy.value
+            ),
             "partitioner": spec.partitioner_type,
             "idxer": spec.idxer_type,
             "rebalance": spec.rebalance,
@@ -322,6 +329,20 @@ def _deserialize_fragment(
             raise ValueError(
                 f"serialized fnum={fnum} != requested {comm_spec.fnum}"
             )
+        # the content hash normally guarantees these, but a moved or
+        # hand-assembled cache must fail HERE, not as a tracer error
+        # deep inside the first query
+        if spec.weighted and not meta["weighted"]:
+            raise ValueError(
+                "serialized fragment has no edge weights but the app "
+                "requires them (spec.weighted=True); re-serialize from "
+                "a weighted load"
+            )
+        if bool(meta["directed"]) != bool(spec.directed):
+            raise ValueError(
+                f"serialized directed={meta['directed']} != requested "
+                f"{spec.directed}"
+            )
         vp = meta["vp"]
         directed, weighted = meta["directed"], meta["weighted"]
         vm = _rebuild_vertex_map(
@@ -358,6 +379,18 @@ def _deserialize_fragment(
     vp = int(z["vp"])
     directed = bool(z["directed"])
     weighted = bool(z["weighted"])
+    # same moved-cache guards as the garc branch
+    if spec.weighted and not weighted:
+        raise ValueError(
+            "serialized fragment has no edge weights but the app "
+            "requires them (spec.weighted=True); re-serialize from a "
+            "weighted load"
+        )
+    if directed != bool(spec.directed):
+        raise ValueError(
+            f"serialized directed={directed} != requested "
+            f"{spec.directed}"
+        )
 
     vm = _rebuild_vertex_map(
         [z[f"oids_{f}"] for f in range(fnum)], fnum, vp, spec

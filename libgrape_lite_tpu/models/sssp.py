@@ -34,7 +34,14 @@ class SSSP(ParallelAppBase):
 
         import jax
 
-        dtype = frag.host_ie[0].edge_w.dtype if frag.weighted else np.float32
+        if not frag.weighted or frag.host_ie[0].edge_w is None:
+            # the reference SSSP requires double edata (run_app.cc:48-52);
+            # fail at init instead of a tracer TypeError mid-superstep
+            raise ValueError(
+                "SSSP requires edge weights; load the graph with "
+                "weighted=True (use BFS for unit-weight traversal)"
+            )
+        dtype = frag.host_ie[0].edge_w.dtype
         if not jax.config.jax_enable_x64:
             # honest TPU dtype: x64-off would downcast silently anyway
             dtype = np.float32

@@ -37,6 +37,61 @@ class FileSink:
         self._f.close()
 
 
+class AsyncSink:
+    """Decouple sample emission from the query loop on a writer thread
+    — the TPU-build form of run_sampler.cc's pending output job
+    (`run_sampler.cc:86-131`: `worker->Output(ostream)` runs on a
+    std::thread while the next batch computes).  Lines flow through a
+    producer-aware BlockingQueue (`utils/thread_pool.py`); `close()`
+    drains and joins."""
+
+    def __init__(self, inner, maxsize: int = 8192):
+        import threading
+
+        from libgrape_lite_tpu.utils.thread_pool import BlockingQueue
+
+        self._inner = inner
+        # bounded: a slow sink applies backpressure to the query loop
+        # (the reference blocks on the previous output job) instead of
+        # buffering the whole backlog in RAM
+        self._q = BlockingQueue(maxsize=maxsize)
+        self._q.set_producer_num(1)
+        self._error: Exception | None = None
+        self._t = threading.Thread(target=self._drain, daemon=True)
+        self._t.start()
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                self._inner.emit(item)
+            except Exception as e:  # surface on the producer side
+                self._error = e
+                # keep draining so producers don't block on a full
+                # queue; lines after the failure are dropped, and the
+                # next emit()/close() raises
+                while self._q.get() is not None:
+                    pass
+                return
+
+    def _check(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async sink writer failed") from err
+
+    def emit(self, line: str) -> None:
+        self._check()
+        self._q.put(line)
+
+    def close(self) -> None:
+        self._q.decrement_producer()
+        self._t.join()
+        self._check()
+        self._inner.close()
+
+
 def kafka_available() -> bool:
     try:
         import confluent_kafka  # noqa: F401

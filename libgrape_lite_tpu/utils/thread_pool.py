@@ -34,10 +34,10 @@ class BlockingQueue:
     """Producer-count-aware MPMC queue (reference concurrent_queue.h):
     consumers see `None` end-markers once every producer finished."""
 
-    def __init__(self):
+    def __init__(self, maxsize: int = 0):
         import threading
 
-        self._q: queue.Queue = queue.Queue()
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
         self._producers = 0
         self._lock = threading.Lock()
 

@@ -6,8 +6,10 @@ for this sandbox; reference scope `/root/reference/Performance.md:21-50`).
       --weighted --out /tmp/rmat24.e
 
 Writes `src dst [w]` lines (integer weights 1..10 so the pandas C
-writer stays fast); chunked so peak memory stays ~2 GB regardless of
-scale.
+writer stays fast).  The CSV WRITE is chunked (bounded text buffers);
+generation itself materialises the full src/dst int64 arrays plus a
+per-bit float64 draw, so peak memory is ~5x the edge-array bytes
+(scale 24 x ef 16: ~20 GiB).
 """
 
 from __future__ import annotations

@@ -86,7 +86,7 @@ def main(argv=None) -> int:
     )
     from libgrape_lite_tpu.sampler.sampler import GraphSampler
     from libgrape_lite_tpu.sampler.stream import (
-        FileSink, FileSource, kafka_available, run_pipeline,
+        AsyncSink, FileSink, FileSource, kafka_available, run_pipeline,
     )
     from libgrape_lite_tpu.utils.timer import phase
 
@@ -124,7 +124,8 @@ def main(argv=None) -> int:
             sink = KafkaSink(args.broker_list, args.output_topic)
         else:
             source = FileSource(args.input_stream)
-            sink = (
+            # async writer thread, like the reference's output job
+            sink = AsyncSink(
                 FileSink(args.output_stream) if args.output_stream
                 else _StdoutSink()
             )

@@ -143,3 +143,43 @@ def test_run_sampler_driver(tmp_path, monkeypatch):
     assert slines[0].strip() == "6:"        # before the update
     assert slines[1].strip() == "6: 7"      # after e 6 7
     assert slines[2].strip() == "7: 6"      # reverse direction too
+
+
+def test_async_sink_preserves_order(tmp_path):
+    """AsyncSink (the reference's threaded output job) must deliver
+    every line in emission order through the BlockingQueue."""
+    from libgrape_lite_tpu.sampler.stream import AsyncSink, FileSink
+
+    out = tmp_path / "async.txt"
+    sink = AsyncSink(FileSink(str(out)))
+    for i in range(500):
+        sink.emit(f"line {i}")
+    sink.close()
+    lines = out.read_text().strip().splitlines()
+    assert lines == [f"line {i}" for i in range(500)]
+
+
+def test_async_sink_surfaces_writer_errors(tmp_path):
+    """A failing writer must raise on the producer side, not exit 0
+    with a truncated file (review r4 finding)."""
+    import pytest
+
+    from libgrape_lite_tpu.sampler.stream import AsyncSink
+
+    class FailSink:
+        def __init__(self):
+            self.n = 0
+
+        def emit(self, line):
+            self.n += 1
+            if self.n >= 2:
+                raise IOError("disk full")
+
+        def close(self):
+            pass
+
+    sink = AsyncSink(FailSink(), maxsize=4)
+    with pytest.raises(RuntimeError, match="async sink writer failed"):
+        for i in range(100):
+            sink.emit(f"line {i}")
+        sink.close()

@@ -134,3 +134,29 @@ def test_garc_string_ids(tmp_path):
         np.testing.assert_array_equal(
             f1.host_ie[f].edge_nbr, f2.host_ie[f].edge_nbr
         )
+
+
+def test_undirected_cache_shared_across_strategies(tmp_path):
+    """Undirected fragments alias oe == ie, so a cache written under
+    one app's load_strategy must satisfy any other (a PageRank
+    --serialize feeds an SSSP --deserialize; regression: RMAT-24 SSSP
+    rebuilt 41 minutes because the sig keyed on the strategy)."""
+    from libgrape_lite_tpu.fragment.loader import LoadGraph
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.partitioner import ExplicitPartitioner
+
+    cs = CommSpec(fnum=2)
+    s1 = _spec(serialize=True, serialization_prefix=str(tmp_path))
+    s1.load_strategy = LoadStrategy.kOnlyOut
+    LoadGraph(dataset_path("p2p-31.e"), dataset_path("p2p-31.v"), cs, s1)
+
+    s2 = _spec(deserialize=True, serialization_prefix=str(tmp_path))
+    s2.load_strategy = LoadStrategy.kBothOutIn
+    frag = LoadGraph(
+        dataset_path("p2p-31.e"), dataset_path("p2p-31.v"), cs, s2
+    )
+    # the deserialize path is the only one that rebuilds the vertex map
+    # through ExplicitPartitioner — proof the cache was hit
+    assert isinstance(frag.vertex_map.partitioner, ExplicitPartitioner)
+    assert frag.host_ie is frag.host_oe
