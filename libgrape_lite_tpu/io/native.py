@@ -33,7 +33,14 @@ def _load() -> ctypes.CDLL | None:
         _tried = True
         if os.environ.get("GRAPE_TPU_NO_NATIVE"):
             return None
-        if not os.path.exists(_SO_PATH):
+        src = os.path.join(_NATIVE_DIR, "loader.cc")
+        stale = not os.path.exists(_SO_PATH) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
+        )
+        if stale:
+            # a stale .so silently loses every symbol group added since
+            # it was built (make is incremental, so this is cheap)
             try:
                 subprocess.run(
                     ["make", "-C", _NATIVE_DIR],
@@ -42,7 +49,8 @@ def _load() -> ctypes.CDLL | None:
                     timeout=120,
                 )
             except Exception:
-                return None
+                if not os.path.exists(_SO_PATH):
+                    return None  # no prebuilt fallback at all
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:
@@ -108,8 +116,67 @@ def _load() -> ctypes.CDLL | None:
             lib._gl_has_vm = True
         except AttributeError:
             lib._gl_has_vm = False
+        try:
+            # varint decode (fragment-cache wire format), added round 4
+            u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+            u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+            lib.gl_varint_count.restype = ctypes.c_int64
+            lib.gl_varint_count.argtypes = [u8p, ctypes.c_int64]
+            lib.gl_varint_decode.restype = ctypes.c_int64
+            lib.gl_varint_decode.argtypes = [
+                u8p, ctypes.c_int64, u64p, ctypes.c_int64, ctypes.c_int,
+            ]
+            lib.gl_varint_size.restype = ctypes.c_int64
+            lib.gl_varint_size.argtypes = [
+                u64p, ctypes.c_int64, ctypes.c_int,
+            ]
+            lib.gl_varint_encode.restype = ctypes.c_int64
+            lib.gl_varint_encode.argtypes = [
+                u64p, ctypes.c_int64, u8p, ctypes.c_int64, ctypes.c_int,
+            ]
+            lib._gl_has_varint = True
+        except AttributeError:
+            lib._gl_has_varint = False
         _lib = lib
         return _lib
+
+
+def varint_encode_native(vals: np.ndarray, delta: bool) -> bytes | None:
+    """Native LEB128 (optionally delta) encode; None when unavailable."""
+    lib = _load()
+    if lib is None or not getattr(lib, "_gl_has_varint", False):
+        return None
+    v = np.ascontiguousarray(vals, dtype=np.uint64)
+    if len(v) == 0:
+        return b""
+    size = lib.gl_varint_size(v, len(v), 1 if delta else 0)
+    out = np.empty(size, dtype=np.uint8)
+    got = lib.gl_varint_encode(v, len(v), out, size, 1 if delta else 0)
+    if got != size:
+        return None
+    return out.tobytes()
+
+
+def varint_decode_native(buf: bytes, delta: bool) -> np.ndarray | None:
+    """Native LEB128 (optionally delta-accumulated) decode; None when
+    the library is unavailable (callers fall back to numpy)."""
+    lib = _load()
+    if lib is None or not getattr(lib, "_gl_has_varint", False):
+        return None
+    b = np.frombuffer(buf, dtype=np.uint8)
+    if len(b) == 0:
+        return np.zeros(0, dtype=np.uint64)
+    n = lib.gl_varint_count(b, len(b))
+    out = np.empty(n, dtype=np.uint64)
+    got = lib.gl_varint_decode(b, len(b), out, n, 1 if delta else 0)
+    if got != n:
+        # gl_varint_decode returns -1 or the exact count, so this is
+        # unambiguously a truncated/overlong stream — the numpy
+        # fallback would silently drop the trailing value instead
+        raise ValueError(
+            f"corrupt varint stream: decoded {got} of {n} values"
+        )
+    return out
 
 
 def _as_i64(a) -> np.ndarray | None:

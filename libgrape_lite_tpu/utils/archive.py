@@ -71,10 +71,16 @@ class OutArchive:
 # ---- varint / delta-varint (reference varint.h) ----
 
 def varint_encode(values: np.ndarray) -> bytes:
-    """LEB128 encode an unsigned int64 array (vectorised)."""
+    """LEB128 encode an unsigned int64 array (native fast path,
+    vectorised numpy fallback)."""
     v = np.asarray(values, dtype=np.uint64)
     if len(v) == 0:
         return b""
+    from libgrape_lite_tpu.io.native import varint_encode_native
+
+    nat = varint_encode_native(v, delta=False)
+    if nat is not None:
+        return nat
     nbytes = np.maximum((70 - _clz64(v)) // 7, 1)  # ceil(bits/7), min 1
     total = int(nbytes.sum())
     out = np.zeros(total, dtype=np.uint8)
@@ -93,6 +99,11 @@ def varint_encode(values: np.ndarray) -> bytes:
 
 
 def varint_decode(buf: bytes) -> np.ndarray:
+    from libgrape_lite_tpu.io.native import varint_decode_native
+
+    nat = varint_decode_native(buf, delta=False)
+    if nat is not None:
+        return nat
     b = np.frombuffer(buf, dtype=np.uint8)
     if len(b) == 0:
         return np.zeros(0, dtype=np.uint64)
@@ -116,11 +127,21 @@ def delta_varint_encode(sorted_values: np.ndarray) -> bytes:
     v = np.asarray(sorted_values, dtype=np.uint64)
     if len(v) == 0:
         return b""
+    from libgrape_lite_tpu.io.native import varint_encode_native
+
+    nat = varint_encode_native(v, delta=True)
+    if nat is not None:
+        return nat
     deltas = np.diff(v, prepend=np.uint64(0))
     return varint_encode(deltas)
 
 
 def delta_varint_decode(buf: bytes) -> np.ndarray:
+    from libgrape_lite_tpu.io.native import varint_decode_native
+
+    nat = varint_decode_native(buf, delta=True)
+    if nat is not None:
+        return nat
     return np.cumsum(varint_decode(buf), dtype=np.uint64)
 
 

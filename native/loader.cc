@@ -504,4 +504,80 @@ int gl_all_weighted(void* handle) {
 
 void gl_free(void* handle) { delete static_cast<Parsed*>(handle); }
 
+// ---- varint / delta-varint decode ----
+//
+// LEB128 uint64 streams are the fragment-cache wire format
+// (utils/archive.py; reference semantics grape/utils/varint.h).  The
+// vectorised numpy decoder is the bottleneck of cache loads at scale
+// (1.7e9 values ~= 10 min); this single-pass scalar loop runs at
+// ~1 GB/s.
+
+// number of encoded values = bytes with the continuation bit clear
+int64_t gl_varint_count(const uint8_t* buf, int64_t nbytes) {
+  int64_t n = 0;
+  for (int64_t i = 0; i < nbytes; ++i) n += !(buf[i] & 0x80);
+  return n;
+}
+
+// decode into out[max_out]; delta != 0 applies the running-sum
+// (delta-varint) transform in the same pass.  Returns the decoded
+// count, or -1 on a truncated/overlong stream or out overflow.
+int64_t gl_varint_decode(const uint8_t* buf, int64_t nbytes,
+                         uint64_t* out, int64_t max_out, int delta) {
+  int64_t n = 0, i = 0;
+  uint64_t acc = 0;
+  while (i < nbytes) {
+    uint64_t v = 0;
+    int shift = 0;
+    for (;;) {
+      if (i >= nbytes || shift > 63) return -1;
+      uint8_t b = buf[i++];
+      v |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    if (n >= max_out) return -1;
+    if (delta) {
+      acc += v;
+      out[n++] = acc;
+    } else {
+      out[n++] = v;
+    }
+  }
+  return n;
+}
+
+// exact encoded size (first pass of the two-pass encode: callers
+// allocate tight instead of the 10n worst case)
+int64_t gl_varint_size(const uint64_t* vals, int64_t n, int delta) {
+  int64_t total = 0;
+  uint64_t prev = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t v = delta ? vals[i] - prev : vals[i];
+    if (delta) prev = vals[i];
+    int bytes = 1;
+    while (v >>= 7) ++bytes;
+    total += bytes;
+  }
+  return total;
+}
+
+// encode; returns bytes written or -1 on overflow of max_bytes
+int64_t gl_varint_encode(const uint64_t* vals, int64_t n, uint8_t* out,
+                         int64_t max_bytes, int delta) {
+  int64_t p = 0;
+  uint64_t prev = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t v = delta ? vals[i] - prev : vals[i];
+    if (delta) prev = vals[i];
+    do {
+      if (p >= max_bytes) return -1;
+      uint8_t b = v & 0x7F;
+      v >>= 7;
+      out[p++] = v ? (b | 0x80) : b;
+    } while (v);
+  }
+  return p;
+}
+
 }  // extern "C"

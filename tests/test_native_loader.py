@@ -77,3 +77,47 @@ def test_native_edge_sort_parity():
     # unweighted path
     out2 = sort_edges_native(src, nbr, None, n_rows, n_cols)
     assert out2[2] is None and np.array_equal(out2[0], src[order])
+
+
+def test_varint_native_matches_numpy_and_detects_corruption():
+    """Native LEB128 codec: byte-identical to the numpy encoder, and a
+    truncated stream raises instead of silently dropping the tail."""
+    import numpy as np
+    import pytest
+
+    from libgrape_lite_tpu.io.native import (
+        varint_decode_native, varint_encode_native,
+    )
+
+    if varint_encode_native(np.zeros(1, np.uint64), False) is None:
+        pytest.skip("native library unavailable")
+
+    rng = np.random.default_rng(4)
+    vals = np.concatenate([
+        rng.integers(0, 128, 50), rng.integers(0, 1 << 40, 50),
+        [0, 1, 127, 128, (1 << 64) - 1],
+    ]).astype(np.uint64)
+
+    import libgrape_lite_tpu.io.native as nat
+    import libgrape_lite_tpu.utils.archive as arc
+
+    enc_nat = varint_encode_native(vals, False)
+    orig = nat.varint_encode_native
+    nat.varint_encode_native = lambda *a, **k: None
+    try:
+        enc_np = arc.varint_encode(vals)
+    finally:
+        nat.varint_encode_native = orig
+    assert enc_nat == enc_np
+    assert np.array_equal(varint_decode_native(enc_nat, False), vals)
+
+    srt = np.sort(vals)
+    assert np.array_equal(
+        varint_decode_native(varint_encode_native(srt, True), True), srt
+    )
+
+    # truncate mid-value: last byte keeps its continuation bit
+    bad = enc_nat[:-1]
+    if bad[-1] & 0x80:
+        with pytest.raises(ValueError, match="corrupt varint"):
+            varint_decode_native(bad, False)
