@@ -51,6 +51,14 @@ def _load() -> ctypes.CDLL | None:
             except Exception:
                 if not os.path.exists(_SO_PATH):
                     return None  # no prebuilt fallback at all
+                import warnings
+
+                warnings.warn(
+                    "native library rebuild failed; loading the stale "
+                    f"{_SO_PATH} — newer symbol groups (and their "
+                    "speedups) may be unavailable",
+                    RuntimeWarning,
+                )
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:

@@ -65,6 +65,9 @@ APP_REGISTRY = {
     "pagerank_parallel": PageRank,
     "pagerank_opt": PageRank,
     "pagerank_push": PageRankAuto,
+    # the reference's push_opt differs from push only by the Opt
+    # message manager (pooled buffers — compiler-managed here)
+    "pagerank_push_opt": PageRankAuto,
     "cdlp": CDLP,
     "cdlp_auto": CDLP,
     "cdlp_opt": CDLPOpt,
@@ -85,7 +88,13 @@ APP_REGISTRY = {
     # pagerank already pulls over in-edges (pagerank_parallel.h
     # semantics), which is the directed-correct formulation
     "pagerank_directed": PageRank,
+    # the reference's opt-mode bc runs the staged pair
+    # StagedBCBFS -> StagedBC (run_app_opt.h:471-472); here both
+    # stages are fused into one PIE program (two while_loops in
+    # BC.peval), so all three names resolve to it
     "bc": BC,
+    "staged_bc": BC,
+    "staged_bc_bfs": BC,
     "kcore": KCore,
     "kclique": KClique,
     "core_decomposition": CoreDecomposition,

@@ -107,6 +107,11 @@ def varint_decode(buf: bytes) -> np.ndarray:
     b = np.frombuffer(buf, dtype=np.uint8)
     if len(b) == 0:
         return np.zeros(0, dtype=np.uint64)
+    if b[-1] & 0x80:
+        # truncated mid-value: match the native decoder instead of
+        # silently dropping the tail
+        raise ValueError("corrupt varint stream: trailing bytes have "
+                         "no terminator")
     is_last = (b & 0x80) == 0
     ends = np.nonzero(is_last)[0]
     starts = np.concatenate([[0], ends[:-1] + 1])
