@@ -114,11 +114,13 @@ class Worker:
         mesh, frag_spec = self._mesh_layout()
         eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
 
-        def stepper(frag_stacked, state, squeezed):
+        def stepper(frag_stacked, state, eph_state, squeezed):
             frag = frag_stacked.local()
-            st_all = _squeeze_state(state, squeezed)
-            # ephemeral leaves (pack stream tables etc.): trace inputs
-            # visible to peval/inceval, excluded from the loop carry
+            # ephemeral leaves (pack stream tables etc.) ride in a
+            # separate, NON-donated argument: they are stripped from the
+            # outputs, so donating them could never alias and would only
+            # draw 'unusable donation' warnings on the largest buffers
+            st_all = _squeeze_state({**state, **eph_state}, squeezed)
             eph_vals = {k: st_all[k] for k in eph}
 
             def strip(s):
@@ -145,17 +147,21 @@ class Worker:
 
         def compile_for(state):
             specs, squeezed = self._key_specs(state)
-            out_state_specs = {
-                k: v for k, v in specs.items() if k not in eph
-            }
+            carry_specs = {k: v for k, v in specs.items() if k not in eph}
+            eph_specs = {k: v for k, v in specs.items() if k in eph}
             sm = jax.shard_map(
                 partial(stepper, squeezed=squeezed),
                 mesh=mesh,
-                in_specs=(frag_spec, specs),
-                out_specs=(out_state_specs, P(), P()),
+                in_specs=(frag_spec, carry_specs, eph_specs),
+                out_specs=(carry_specs, P(), P()),
                 check_vma=False,
             )
-            return jax.jit(sm)
+            # donate the placed carry state: every query places fresh
+            # buffers (query -> _place_state), so XLA may alias them
+            # into the loop carry instead of holding input + output
+            # copies in HBM (fragment CSRs and ephemeral tables are
+            # reused / output-less and stay un-donated)
+            return jax.jit(sm, donate_argnums=(1,))
 
         return compile_for
 
@@ -198,7 +204,10 @@ class Worker:
 
         state = self._place_state(app.init_state(frag, **query_args))
         runner = self._runner_for(mr, state)
-        out_state, rounds, active = runner(frag.dev, state)
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        carry = {k: v for k, v in state.items() if k not in eph}
+        eph_part = {k: v for k, v in state.items() if k in eph}
+        out_state, rounds, active = runner(frag.dev, carry, eph_part)
         out_state = jax.block_until_ready(out_state)
         self.rounds = int(rounds)
         self._terminate_code = min(0, int(active))
