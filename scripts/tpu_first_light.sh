@@ -1,0 +1,55 @@
+#!/bin/bash -e
+set -o pipefail
+# First-live-window playbook (VERDICT r3 next #1): run the complete
+# hardware measurement sequence the moment the TPU tunnel answers.
+# Usage:  bash scripts/tpu_first_light.sh [outdir]
+# The background watcher (scripts/tpu_watch.sh) writes .tpu_alive and
+# exits when the chip responds; this script is the follow-up — it can
+# also be run directly (it re-probes first and aborts fast if dead).
+cd "$(dirname "$0")/.."
+OUT=${1:-scratch/first_light}
+mkdir -p "$OUT"
+
+echo "== probe =="
+if ! timeout 120 python -c "import jax; d=jax.devices(); print(d)"; then
+  echo "tunnel dead; aborting" >&2
+  exit 1
+fi
+
+echo "== primitive rates (prices the sublane dynamic_gather — the
+cost-model unknown; see docs/PERF_NOTES.md r4 section) =="
+timeout 900 python scripts/pallas_probe.py 2> "$OUT/probe.err" | tee "$OUT/probe.json" || true
+
+echo "== bench A/B (xla vs pack, PageRank + SSSP) =="
+GRAPE_BENCH_ASSUME_ALIVE=1 timeout 3600 python bench.py 2> "$OUT/bench.err" | tee "$OUT/bench.json"
+tail -20 "$OUT/bench.err"
+
+echo "== per-stage profile (stepwise mode, per-round wall clock) =="
+GRAPE_SPMV=pack GRAPE_TPU_VLOG=1 timeout 1200 python - <<'EOF' 2>&1 | tee "$OUT/profile.log" || true
+import sys
+sys.path.insert(0, ".")
+import numpy as np
+from bench import rmat_edges
+from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+from libgrape_lite_tpu.utils.id_parser import IdParser
+from libgrape_lite_tpu.utils.types import LoadStrategy
+from libgrape_lite_tpu.vertex_map.idxer import HashMapIdxer
+from libgrape_lite_tpu.vertex_map.partitioner import SegmentedPartitioner
+from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+from libgrape_lite_tpu.models import PageRank
+from libgrape_lite_tpu.worker.worker import Worker
+
+n, src, dst = rmat_edges(20, 16)
+oids = np.arange(n, dtype=np.int64)
+part = SegmentedPartitioner(1, oids)
+vm = VertexMap(part, [HashMapIdxer(oids)], IdParser(1, n))
+frag = ShardedEdgecutFragment.build(
+    CommSpec(fnum=1), vm, src, dst, None, directed=False,
+    load_strategy=LoadStrategy.kBothOutIn)
+app = PageRank(delta=0.85, max_round=10)
+w = Worker(app, frag)
+w.query_stepwise(max_rounds=10)   # logs per-round wall clock
+EOF
+
+echo "== done; results in $OUT =="
