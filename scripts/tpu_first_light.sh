@@ -1,18 +1,28 @@
-#!/bin/bash -e
-set -o pipefail
+#!/bin/bash
 # First-live-window playbook (VERDICT r3 next #1): run the complete
 # hardware measurement sequence the moment the TPU tunnel answers.
 # Usage:  bash scripts/tpu_first_light.sh [outdir]
 # The background watcher (scripts/tpu_watch.sh) writes .tpu_alive and
 # exits when the chip responds; this script is the follow-up — it can
 # also be run directly (it re-probes first and aborts fast if dead).
+set -eo pipefail
 cd "$(dirname "$0")/.."
 OUT=${1:-scratch/first_light}
 mkdir -p "$OUT"
+# plans persist across every step below AND later bench re-runs
+export GRAPE_PACK_PLAN_CACHE="$PWD/scratch/pack_plans"
 
 echo "== probe =="
-if ! timeout 120 python -c "import jax; d=jax.devices(); print(d)"; then
-  echo "tunnel dead; aborting" >&2
+# must see a REAL accelerator: a failed axon init can fall back to CPU,
+# where the pack A/B runs interpret-mode ('not a measurement') and
+# burns the live window
+if ! timeout 120 python -c "
+import jax
+d = jax.devices()
+print(d)
+assert d and d[0].platform != 'cpu', f'CPU fallback: {d}'
+"; then
+  echo "tunnel dead (or CPU fallback); aborting" >&2
   exit 1
 fi
 
@@ -21,8 +31,9 @@ cost-model unknown; see docs/PERF_NOTES.md r4 section) =="
 timeout 900 python scripts/pallas_probe.py 2> "$OUT/probe.err" | tee "$OUT/probe.json" || true
 
 echo "== bench A/B (xla vs pack, PageRank + SSSP) =="
-GRAPE_BENCH_ASSUME_ALIVE=1 timeout 3600 python bench.py 2> "$OUT/bench.err" | tee "$OUT/bench.json"
-tail -20 "$OUT/bench.err"
+GRAPE_BENCH_ASSUME_ALIVE=1 timeout 3600 python bench.py \
+  2> "$OUT/bench.err" | tee "$OUT/bench.json" \
+  || { tail -20 "$OUT/bench.err" >&2; exit 1; }
 
 echo "== per-stage profile (stepwise mode, per-round wall clock) =="
 GRAPE_SPMV=pack GRAPE_TPU_VLOG=1 timeout 1200 python - <<'EOF' 2>&1 | tee "$OUT/profile.log" || true
