@@ -82,6 +82,51 @@ def _backend_alive(timeout_s: int = 150) -> bool:
         return False
 
 
+def build_bench_fragment():
+    """The bench graph + fragment, shared with scripts/seed_pack_plans.py
+    so the pre-seeded plan-cache digests stay bit-identical by
+    construction.  The real load path: hash-partitioned vertex map over
+    the native open-addressing idxer (round 1 bypassed VertexMap with an
+    identity idxer because the dict path was load-bound; the native
+    table is ~30x faster, so the bench exercises the honest path)."""
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.id_parser import IdParser
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.idxer import HashMapIdxer
+    from libgrape_lite_tpu.vertex_map.partitioner import (
+        SegmentedPartitioner,
+    )
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+
+    n, src, dst = rmat_edges(SCALE, EDGE_FACTOR)
+    comm_spec = CommSpec(fnum=1)
+    oids = np.arange(n, dtype=np.int64)
+    part = SegmentedPartitioner(1, oids)
+    vm = VertexMap(part, [HashMapIdxer(oids)], IdParser(1, n))
+    frag = ShardedEdgecutFragment.build(
+        comm_spec, vm, src, dst, None,
+        directed=False,
+        load_strategy=LoadStrategy.kBothOutIn,
+    )
+    return n, src, dst, comm_spec, vm, frag
+
+
+def build_bench_weighted_fragment(src, dst, comm_spec, vm):
+    """The SSSP lane's weighted twin (seed-11 uniform(0.1,10) f32) —
+    also shared with the plan-cache seeder."""
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+
+    rng_w = np.random.default_rng(11)
+    w = rng_w.uniform(0.1, 10.0, size=len(src)).astype(np.float32)
+    return ShardedEdgecutFragment.build(
+        comm_spec, vm, src, dst, w,
+        directed=False,
+        load_strategy=LoadStrategy.kBothOutIn,
+    )
+
+
 def main():
     suffix = ""
     # ALWAYS probe in a subprocess before touching the default backend:
@@ -104,16 +149,9 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         suffix = "_cpu_fallback"
 
-    import jax
+    import jax  # noqa: F401 — backend init order matters
 
-    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
     from libgrape_lite_tpu.models import PageRank
-    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
-    from libgrape_lite_tpu.utils.id_parser import IdParser
-    from libgrape_lite_tpu.utils.types import LoadStrategy
-    from libgrape_lite_tpu.vertex_map.idxer import HashMapIdxer
-    from libgrape_lite_tpu.vertex_map.partitioner import SegmentedPartitioner
-    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
     from libgrape_lite_tpu.worker.worker import Worker
 
     # persist pack plans across bench invocations: a live-TPU window is
@@ -125,22 +163,8 @@ def main():
                      "scratch", "pack_plans"),
     )
 
-    n, src, dst = rmat_edges(SCALE, EDGE_FACTOR)
-    comm_spec = CommSpec(fnum=1)
-
-    # the real load path: hash-partitioned vertex map over the native
-    # open-addressing idxer (round 1 bypassed VertexMap with an identity
-    # idxer because the dict path was load-bound; the native table is
-    # ~30x faster, so the bench now exercises the honest path)
     t_load0 = time.perf_counter()
-    oids = np.arange(n, dtype=np.int64)
-    part = SegmentedPartitioner(1, oids)
-    vm = VertexMap(part, [HashMapIdxer(oids)], IdParser(1, n))
-    frag = ShardedEdgecutFragment.build(
-        comm_spec, vm, src, dst, None,
-        directed=False,
-        load_strategy=LoadStrategy.kBothOutIn,
-    )
+    n, src, dst, comm_spec, vm, frag = build_bench_fragment()
     t_load = time.perf_counter() - t_load0
     e_sym = 2 * len(src)  # undirected pull touches each edge twice per round
 
@@ -236,13 +260,7 @@ def main():
     try:
         from libgrape_lite_tpu.models import SSSP
 
-        rng_w = np.random.default_rng(11)
-        w = rng_w.uniform(0.1, 10.0, size=len(src)).astype(np.float32)
-        frag_w = ShardedEdgecutFragment.build(
-            comm_spec, vm, src, dst, w,
-            directed=False,
-            load_strategy=LoadStrategy.kBothOutIn,
-        )
+        frag_w = build_bench_weighted_fragment(src, dst, comm_spec, vm)
         ss = ab("sssp", SSSP, frag_w, {"source": 0})
         if ss is not None:
             ss_time, ss_winner = ss

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Pre-seed the persistent pack-plan cache for bench.py's exact
+geometry (host-side O(E log E) planning is hardware-independent, so
+doing it ahead of a live-TPU window means `GRAPE_SPMV=pack bench.py`
+loads the plan instead of spending live minutes building it).
+
+The fragments come from bench.build_bench_fragment /
+build_bench_weighted_fragment — the SAME code bench runs — so the
+content-addressed digests match by construction.  Exits nonzero when
+either plan fails to build (a silent MISS would only be discovered
+during the live window)."""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault(
+    "GRAPE_PACK_PLAN_CACHE", os.path.join(REPO, "scratch", "pack_plans")
+)
+
+from bench import build_bench_fragment, build_bench_weighted_fragment
+from libgrape_lite_tpu.ops.spmv_pack import resolve_pack_dispatch
+
+n, src, dst, comm_spec, vm, frag = build_bench_fragment()
+d = resolve_pack_dispatch(frag)
+print("pagerank plan:", "ok" if d is not None else "MISSED", flush=True)
+
+frag_w = build_bench_weighted_fragment(src, dst, comm_spec, vm)
+dw = resolve_pack_dispatch(frag_w, with_weights=True)
+print("sssp plan:", "ok" if dw is not None else "MISSED", flush=True)
+
+sys.exit(0 if (d is not None and dw is not None) else 1)

@@ -34,6 +34,9 @@ echo "== bench A/B (xla vs pack, PageRank + SSSP) =="
 GRAPE_BENCH_ASSUME_ALIVE=1 timeout 3600 python bench.py \
   2> "$OUT/bench.err" | tee "$OUT/bench.json" \
   || { tail -20 "$OUT/bench.err" >&2; exit 1; }
+# pack-ineligibility / fallback warnings matter even on success — a
+# silent xla-only A/B must not read as a pack measurement
+grep -iE "pack|warn" "$OUT/bench.err" | tail -10 || true
 
 echo "== per-stage profile (stepwise mode, per-round wall clock) =="
 GRAPE_SPMV=pack GRAPE_TPU_VLOG=1 timeout 1200 python - <<'EOF' 2>&1 | tee "$OUT/profile.log" || true
