@@ -42,6 +42,9 @@ import numpy as np
 
 
 BASELINE_MTEPS_PER_CHIP = 3500.0
+PLAN_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "scratch", "pack_plans"
+)
 SSSP_BASELINE_MTEPS_PER_CHIP = 267.0
 SCALE = int(os.environ.get("GRAPE_BENCH_SCALE", 20))  # 2^20 vertices
 EDGE_FACTOR = 16
@@ -157,11 +160,7 @@ def main():
     # persist pack plans across bench invocations: a live-TPU window is
     # scarce, and re-running the O(E log E) host planner on every A/B
     # wastes minutes of it (explicit GRAPE_PACK_PLAN_CACHE wins)
-    os.environ.setdefault(
-        "GRAPE_PACK_PLAN_CACHE",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "scratch", "pack_plans"),
-    )
+    os.environ.setdefault("GRAPE_PACK_PLAN_CACHE", PLAN_CACHE_DIR)
 
     t_load0 = time.perf_counter()
     n, src, dst, comm_spec, vm, frag = build_bench_fragment()

@@ -12,13 +12,23 @@ during the live window)."""
 import os
 import sys
 
+# host-side planning never needs the TPU: pin CPU before any jax import
+# (the axon plugin can hang backend init when the tunnel is in limbo,
+# and it registers via sitecustomize regardless of JAX_PLATFORMS)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-os.environ.setdefault(
-    "GRAPE_PACK_PLAN_CACHE", os.path.join(REPO, "scratch", "pack_plans")
-)
 
-from bench import build_bench_fragment, build_bench_weighted_fragment
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from bench import PLAN_CACHE_DIR, build_bench_fragment, \
+    build_bench_weighted_fragment
+
+os.environ.setdefault("GRAPE_PACK_PLAN_CACHE", PLAN_CACHE_DIR)
 from libgrape_lite_tpu.ops.spmv_pack import resolve_pack_dispatch
 
 n, src, dst, comm_spec, vm, frag = build_bench_fragment()

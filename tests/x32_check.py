@@ -70,6 +70,43 @@ def main():
         res = run_worker(LCC(), frag)
         eps_verify(res, load_golden(dataset_path("p2p-31-LCC")), eps=1e-4)
 
+        # pack backend against the SAME goldens (VERDICT r3 weak #3:
+        # the x64 matrix can never engage pack — f32-only — so this
+        # x32 lane is where pack meets the reference outputs directly,
+        # not merely the XLA path)
+        from libgrape_lite_tpu.models import WCC
+        from tests.verifiers import wcc_verify
+
+        prev_spmv = os.environ.get("GRAPE_SPMV")
+        os.environ["GRAPE_SPMV"] = "pack"
+        try:
+            app = SSSP()
+            res = run_worker(app, frag, source=6)
+            assert app._pack is not None, "sssp pack not engaged"
+            eps_verify(res, load_golden(dataset_path("p2p-31-SSSP")),
+                       eps=1e-3)
+
+            app = BFS()
+            res = run_worker(app, frag, source=6)
+            assert app._pack is not None, "bfs pack not engaged"
+            exact_verify(res, load_golden(dataset_path("p2p-31-BFS")))
+
+            app = PageRank()
+            res = run_worker(app, frag, delta=0.85, max_round=10)
+            assert app._pack is not None, "pagerank pack not engaged"
+            eps_verify(res, load_golden(dataset_path("p2p-31-PR")),
+                       eps=1e-3)
+
+            app = WCC()
+            res = run_worker(app, frag)
+            assert app._pack_ie is not None, "wcc pack not engaged"
+            wcc_verify(res, load_golden(dataset_path("p2p-31-WCC")))
+        finally:
+            if prev_spmv is None:
+                os.environ.pop("GRAPE_SPMV", None)
+            else:
+                os.environ["GRAPE_SPMV"] = prev_spmv
+
     print("X32-LANE-OK")
 
 
