@@ -147,19 +147,29 @@ def LoadGraph(
 _GARC_MAGIC = 0x47415243  # "GARC"
 
 # stream encodings (flag byte per array)
-_ENC_RAW, _ENC_VARINT, _ENC_DELTA, _ENC_BITS, _ENC_PICKLE = range(5)
+# _ENC_PICKLE is write-dead since format v3: a crafted cache file must
+# not reach pickle.loads at deserialize time (arbitrary code execution);
+# string oids use length-prefixed UTF-8 (_ENC_STR) instead
+_ENC_RAW, _ENC_VARINT, _ENC_DELTA, _ENC_BITS, _ENC_PICKLE, _ENC_STR = range(6)
 
 
 def _put_array(ar, a: np.ndarray) -> None:
     """Append one array: flag byte, element count, payload, dtype tag."""
     a = np.asarray(a)
-    if a.dtype == object:  # string oids
-        import pickle
+    if a.dtype == object:  # string oids: varint lengths + UTF-8 payload
+        from libgrape_lite_tpu.utils.archive import varint_encode
 
-        blob = pickle.dumps(a)
-        ar.add_scalar(_ENC_PICKLE, "<b")
-        ar.add_scalar(len(blob))
-        ar.add_bytes(blob)
+        blobs = [str(s).encode("utf-8") for s in a.tolist()]
+        lens = varint_encode(
+            np.array([len(b) for b in blobs], dtype=np.uint64)
+        )
+        payload = b"".join(blobs)
+        ar.add_scalar(_ENC_STR, "<b")
+        ar.add_scalar(len(a))
+        ar.add_scalar(len(lens))
+        ar.add_bytes(lens)
+        ar.add_scalar(len(payload))
+        ar.add_bytes(payload)
         return
     from libgrape_lite_tpu.utils.archive import (
         delta_varint_encode, varint_encode,
@@ -190,16 +200,33 @@ def _put_array(ar, a: np.ndarray) -> None:
 
 
 def _get_array(oa) -> np.ndarray:
-    import pickle
-
     from libgrape_lite_tpu.utils.archive import (
         delta_varint_decode, varint_decode,
     )
 
     enc = oa.get_scalar("<b")
     if enc == _ENC_PICKLE:
-        nbytes = oa.get_scalar()
-        return pickle.loads(bytes(oa.get_bytes(nbytes)))
+        raise ValueError(
+            "pickle-era garc stream refused (deserializing it would run "
+            "arbitrary code from the cache file); delete the cache dir "
+            "and re-serialize from source"
+        )
+    if enc == _ENC_STR:
+        n = oa.get_scalar()
+        nlens = oa.get_scalar()
+        lens = varint_decode(bytes(oa.get_bytes(nlens)))
+        npay = oa.get_scalar()
+        payload = bytes(oa.get_bytes(npay))
+        # fail loudly on corrupt/crafted streams (the hardening point
+        # of this format): count and payload extent must match exactly
+        if len(lens) != n or int(lens.sum()) != len(payload):
+            raise ValueError("corrupt string stream in frag.garc")
+        out = np.empty(n, dtype=object)
+        pos = 0
+        for i, ln in enumerate(lens.tolist()):
+            out[i] = payload[pos:pos + ln].decode("utf-8")
+            pos += ln
+        return out
     n = oa.get_scalar()
     if enc == _ENC_BITS:
         vals = np.unpackbits(
@@ -229,7 +256,7 @@ def _serialize_fragment(frag: ShardedEdgecutFragment, cache: str, sig: str):
     aliased = frag.host_ie is frag.host_oe
     ar = InArchive()
     ar.add_scalar(_GARC_MAGIC)
-    ar.add_scalar(2)  # format version
+    ar.add_scalar(3)  # format version (v3: string oids are UTF-8, not pickle)
     for v in (
         frag.fnum, frag.vp, int(frag.directed), int(frag.weighted),
         int(aliased), frag.dev.total_vnum, frag.dev.total_enum,
@@ -272,7 +299,9 @@ def _read_garc(cache: str):
     if oa.get_scalar() != _GARC_MAGIC:
         raise ValueError("bad garc magic")
     version = oa.get_scalar()
-    if version != 2:
+    # v2 accepted for non-string-oid caches; its pickle streams (string
+    # oids only) are refused stream-by-stream in _get_array
+    if version not in (2, 3):
         raise ValueError(f"unsupported garc version {version}")
     (fnum, vp, directed, weighted, aliased, total_vnum,
      total_enum) = (oa.get_scalar() for _ in range(7))
@@ -295,7 +324,8 @@ def _read_garc(cache: str):
             w = _get_array(oa) if has_w else None
             entry[side] = (indptr, src, nbr, mask, ne, w)
         frags.append(entry)
-    assert oa.empty(), "trailing bytes in frag.garc"
+    if not oa.empty():  # not an assert: must survive `python -O`
+        raise ValueError("trailing bytes in frag.garc")
     return meta, frags
 
 
@@ -369,8 +399,15 @@ def _deserialize_fragment(
             comm_spec, vm, dev, host_oe, host_ie, directed, weighted
         )
 
-    # legacy npz caches written before the garc format
-    z = np.load(os.path.join(cache, "frag.npz"), allow_pickle=True)
+    # legacy npz caches written before the garc format.  Pickle is only
+    # required for object (string-oid) arrays; for the common int-oid
+    # case refuse pickled payloads outright so a crafted cache file
+    # can't execute code.  string_id=True legacy caches therefore
+    # require a trusted serialization_prefix — re-serialize to get the
+    # pickle-free garc format.
+    z = np.load(
+        os.path.join(cache, "frag.npz"), allow_pickle=bool(spec.string_id)
+    )
     fnum = int(z["fnum"])
     if fnum != comm_spec.fnum:
         raise ValueError(

@@ -67,8 +67,13 @@ def _parse_columns_parallel(data: bytes, int_cols: int, want_cols: int):
         return _parse_columns(b"", int_cols, want_cols)
     # a chunk of all-2-field lines in a weighted file yields fewer
     # columns; pad with NaN (the single-parse semantics) rather than
-    # silently dropping the column file-wide
+    # silently dropping the column file-wide.  Only float columns
+    # (index >= int_cols) may be padded: NaN-padding an int id column
+    # would float64-degrade oids above 2^53 — a chunk missing an id
+    # column is malformed input, so reparse serially to surface it.
     ncol = max(len(p) for p in parts)
+    if any(len(p) < min(ncol, int_cols) for p in parts):
+        return _parse_columns(data, int_cols, want_cols)
     padded = [
         list(p) + [
             np.full(len(p[0]), np.nan) for _ in range(ncol - len(p))

