@@ -246,6 +246,10 @@ def main():
         "value": round(mteps, 1),
         "unit": "MTEPS/chip",
         "vs_baseline": round(mteps / BASELINE_MTEPS_PER_CHIP, 3),
+        # occupancy context (VERDICT r4 weak #2): fallback numbers on a
+        # shared 1-core box wobble with box load; a reader comparing
+        # rounds must be able to see whether the box was contended
+        "load_avg_1m": round(os.getloadavg()[0], 2),
     }
 
     # the primary measurement goes out BEFORE the SSSP lane: a chip
@@ -257,10 +261,17 @@ def main():
     # second north star: SSSP on the same graph, weighted (best-effort —
     # a failure must not cost the PageRank measurement)
     try:
-        from libgrape_lite_tpu.models import SSSP
+        from libgrape_lite_tpu.models import APP_REGISTRY
+        from libgrape_lite_tpu.models.sssp_select import select_sssp_variant
 
         frag_w = build_bench_weighted_fragment(src, dst, comm_spec, vm)
-        ss = ab("sssp", SSSP, frag_w, {"source": 0})
+        # probe-and-pick (VERDICT r4 next #4): the bench runs whichever
+        # variant the evidence picks for this graph — RMAT is
+        # low-diameter, so this resolves to the dense pull, but the
+        # decision is now measured, not assumed
+        picked, reason = select_sssp_variant(frag_w, 0)
+        print(f"[bench] sssp_select -> {picked}: {reason}", file=sys.stderr)
+        ss = ab("sssp", APP_REGISTRY[picked], frag_w, {"source": 0})
         if ss is not None:
             ss_time, ss_winner = ss
             ss_mteps = e_sym / ss_time / 1e6
@@ -270,6 +281,7 @@ def main():
                     f"sssp_rmat{SCALE}_mteps_per_chip{ss_tag}{suffix}",
                 "value": round(ss_mteps, 1),
                 "unit": "MTEPS/chip",
+                "variant": picked,
                 "vs_baseline":
                     round(ss_mteps / SSSP_BASELINE_MTEPS_PER_CHIP, 3),
             }

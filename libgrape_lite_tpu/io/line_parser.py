@@ -109,9 +109,18 @@ def _parse_columns(data: bytes, int_cols: int, want_cols: int):
         cols = []
         for i in range(min(want_cols, df.shape[1])):
             c = df.iloc[:, i].to_numpy()
-            cols.append(
-                c.astype(np.int64) if i < int_cols else c.astype(np.float64)
-            )
+            if i < int_cols:
+                # pandas NaN-fills short rows and astype(int64) would
+                # turn NaN into INT64_MIN silently — a missing id
+                # field must be an error, not a bogus vertex
+                if c.dtype.kind == "f" and np.isnan(c).any():
+                    raise ValueError(
+                        f"malformed input: id column {i} has missing "
+                        "fields"
+                    )
+                cols.append(c.astype(np.int64))
+            else:
+                cols.append(c.astype(np.float64))
         return cols
     # numpy fallback: two passes to keep id precision
     ids = np.loadtxt(

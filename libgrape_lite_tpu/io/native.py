@@ -145,8 +145,48 @@ def _load() -> ctypes.CDLL | None:
             lib._gl_has_varint = True
         except AttributeError:
             lib._gl_has_varint = False
+        try:
+            # float byte-plane transpose (garc weight streams), round 5
+            u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+            lib.gl_byte_split.restype = None
+            lib.gl_byte_split.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int, u8p,
+            ]
+            lib.gl_byte_join.restype = None
+            lib.gl_byte_join.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int, u8p,
+            ]
+            lib._gl_has_bytesplit = True
+        except AttributeError:
+            lib._gl_has_bytesplit = False
         _lib = lib
         return _lib
+
+
+def byte_split(a: np.ndarray) -> np.ndarray:
+    """[n] itemsize-wide array -> [itemsize, n] uint8 planes (native
+    transpose when available; numpy reshape fallback)."""
+    n, itemsize = len(a), a.dtype.itemsize
+    flat = np.ascontiguousarray(a).view(np.uint8).reshape(-1)
+    lib = _load()
+    if lib is not None and getattr(lib, "_gl_has_bytesplit", False) and n:
+        out = np.empty(itemsize * n, dtype=np.uint8)
+        lib.gl_byte_split(flat, n, itemsize, out)
+        return out.reshape(itemsize, n)
+    return flat.reshape(n, itemsize).T.copy()
+
+
+def byte_join(planes: np.ndarray, dtype) -> np.ndarray:
+    """Inverse of byte_split: [itemsize, n] uint8 planes -> [n] dtype."""
+    itemsize, n = planes.shape
+    assert np.dtype(dtype).itemsize == itemsize
+    lib = _load()
+    if lib is not None and getattr(lib, "_gl_has_bytesplit", False) and n:
+        out = np.empty(itemsize * n, dtype=np.uint8)
+        lib.gl_byte_join(np.ascontiguousarray(planes).reshape(-1), n,
+                         itemsize, out)
+        return out.view(dtype)
+    return np.ascontiguousarray(planes.T).reshape(-1).view(dtype)
 
 
 def varint_encode_native(vals: np.ndarray, delta: bool) -> bytes | None:

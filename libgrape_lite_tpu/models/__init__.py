@@ -46,6 +46,9 @@ from libgrape_lite_tpu.models.auto_apps import (
 
 APP_REGISTRY = {
     "sssp": SSSP,
+    # probe-and-pick: host BFS hop probe chooses dense vs delta at
+    # query time (models/sssp_select.py; near-far heuristic analogue)
+    "sssp_select": SSSP,
     "sssp_auto": SSSPAuto,
     # sssp_opt = the reference's worklist-optimized variant
     # (cuda/sssp/sssp.h near/far): here the bucketed delta-stepping app

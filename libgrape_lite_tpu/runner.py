@@ -172,6 +172,20 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
 
         print(f"[memory] after load: {get_memory_stats()}")
 
+    if name == "sssp_select":
+        # per-(graph, source) dense-vs-delta decision on evidence
+        # (models/sssp_select.py); the probe runs on the host CSRs the
+        # load just produced, before any device compile
+        from libgrape_lite_tpu.models.sssp_select import select_sssp_variant
+        from libgrape_lite_tpu.utils import logging as glog
+
+        with timer.phase("sssp variant probe"):
+            picked, reason = select_sssp_variant(
+                frag, _coerce_source(args.sssp_source, args.string_id)
+            )
+        glog.log_info(f"sssp_select -> {picked}: {reason}")
+        app = APP_REGISTRY[picked]()
+
     with timer.phase("load application"):
         worker = Worker(app, frag)
 

@@ -580,4 +580,46 @@ int64_t gl_varint_encode(const uint64_t* vals, int64_t n, uint8_t* out,
   return p;
 }
 
+// ---- float-stream byte-plane codec ----
+//
+// Serialize-side twin of the varint codec (VERDICT r4 next #5;
+// reference symmetric codec grape/utils/varint.h:39-402): weight
+// streams dominate frag.garc bytes at scale, and raw IEEE floats are
+// incompressible as a unit — but byte-plane transposed, the
+// sign/exponent plane deflates ~4x while mantissa planes stay raw
+// (measured: 20M uniform f32, plane 3: 20 MB -> 5.1 MB).  These two
+// passes are the transpose; the per-plane deflate decision lives in
+// fragment/loader.py.
+
+// out[plane * n + i] = in[i * itemsize + plane].  Tiled so the input
+// is read once and every plane's write run stays within one cache
+// line burst (a plane-per-pass loop re-reads the whole input
+// `itemsize` times and runs no faster than numpy's strided copy).
+void gl_byte_split(const uint8_t* in, int64_t n, int itemsize,
+                   uint8_t* out) {
+  const int64_t TILE = 1 << 14;
+  for (int64_t i0 = 0; i0 < n; i0 += TILE) {
+    int64_t i1 = i0 + TILE < n ? i0 + TILE : n;
+    for (int p = 0; p < itemsize; ++p) {
+      const uint8_t* src = in + p + i0 * itemsize;
+      uint8_t* dst = out + (int64_t)p * n + i0;
+      for (int64_t i = 0; i < i1 - i0; ++i) dst[i] = src[i * itemsize];
+    }
+  }
+}
+
+// inverse of gl_byte_split, same tiling
+void gl_byte_join(const uint8_t* in, int64_t n, int itemsize,
+                  uint8_t* out) {
+  const int64_t TILE = 1 << 14;
+  for (int64_t i0 = 0; i0 < n; i0 += TILE) {
+    int64_t i1 = i0 + TILE < n ? i0 + TILE : n;
+    for (int p = 0; p < itemsize; ++p) {
+      const uint8_t* src = in + (int64_t)p * n + i0;
+      uint8_t* dst = out + p + i0 * itemsize;
+      for (int64_t i = 0; i < i1 - i0; ++i) dst[i * itemsize] = src[i];
+    }
+  }
+}
+
 }  // extern "C"

@@ -83,6 +83,35 @@ def test_cdlp_opt(graph_cache, fnum):
     exact_verify(res, load_golden(dataset_path("p2p-31-CDLP")))
 
 
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_cdlp_dynamic_compression(graph_cache, fnum):
+    """Dynamic label-universe compression (the RMAT-20+ wide-path
+    replacement): force the dynamic path; p2p-31's live universe fits
+    the budget, so every round takes the packed-compressed branch of
+    the in-jit lax.cond — must stay golden-exact."""
+    from libgrape_lite_tpu.models import CDLP
+
+    frag = graph_cache(fnum)
+    app = CDLP()
+    app._force_dynamic = True
+    res = run_worker(app, frag, max_round=10)
+    exact_verify(res, load_golden(dataset_path("p2p-31-CDLP")))
+
+
+def test_cdlp_dynamic_wide_fallback(graph_cache):
+    """Shrink the universe budget below the live label count so the
+    lax.cond's runtime check routes every round to the wide branch —
+    the fallback must also stay golden-exact."""
+    from libgrape_lite_tpu.models import CDLP
+
+    frag = graph_cache(4)
+    app = CDLP()
+    app._force_dynamic = True
+    app._u_budget_override = 64  # << p2p-31's 62k live labels
+    res = run_worker(app, frag, max_round=10)
+    exact_verify(res, load_golden(dataset_path("p2p-31-CDLP")))
+
+
 def test_cdlp_opt_single_round(graph_cache):
     """max_round=1 exercises exactly the shortcut round."""
     from libgrape_lite_tpu.models import CDLP, CDLPOpt

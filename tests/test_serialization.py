@@ -160,3 +160,59 @@ def test_undirected_cache_shared_across_strategies(tmp_path):
     # through ExplicitPartitioner — proof the cache was hit
     assert isinstance(frag.vertex_map.partitioner, ExplicitPartitioner)
     assert frag.host_ie is frag.host_oe
+
+
+def test_put_get_array_codecs_roundtrip():
+    """Every stream encoding in the v3 garc format round-trips exactly,
+    including the float byte-plane codec on special values and the
+    compact (deflated-varint) variant."""
+    from libgrape_lite_tpu.fragment.loader import (
+        _FPLANE_MIN, _get_array, _put_array,
+    )
+    from libgrape_lite_tpu.utils.archive import InArchive, OutArchive
+
+    rng = np.random.default_rng(0)
+    n = _FPLANE_MIN + 17
+    f32 = rng.uniform(0.1, 10, n).astype(np.float32)
+    f32[:4] = [np.inf, -np.inf, np.nan, -0.0]
+    f64 = rng.normal(size=n) * 1e18
+    arrays = [
+        f32, f64,
+        np.sort(rng.integers(0, 1 << 40, n)),       # delta stream
+        rng.integers(0, 1 << 30, n),                # varint stream
+        rng.random(n) < 0.5,                        # bit stream
+        np.array(["a", "β", "", "x" * 300], dtype=object),  # utf-8 oids
+        rng.integers(-5, 5, n),                     # raw (negatives)
+        np.zeros(0, dtype=np.float32),              # empty
+    ]
+    for compact in (False, True):
+        if compact:
+            os.environ["GRAPE_GARC_COMPACT"] = "1"
+        try:
+            ar = InArchive()
+            for a in arrays:
+                _put_array(ar, a)
+            oa = OutArchive(ar.get_buffer())
+            for a in arrays:
+                got = _get_array(oa)
+                if a.dtype == object:
+                    assert got.tolist() == a.tolist()
+                else:
+                    np.testing.assert_array_equal(got, a)
+                    assert got.dtype == a.dtype
+            assert oa.empty()
+        finally:
+            os.environ.pop("GRAPE_GARC_COMPACT", None)
+
+
+def test_garc_refuses_pickle_stream():
+    """A crafted pickle-era stream must be refused, not executed."""
+    from libgrape_lite_tpu.fragment.loader import _ENC_PICKLE, _get_array
+    from libgrape_lite_tpu.utils.archive import InArchive, OutArchive
+
+    ar = InArchive()
+    ar.add_scalar(_ENC_PICKLE, "<b")
+    ar.add_scalar(4)
+    ar.add_bytes(b"\x80\x04N.")
+    with pytest.raises(ValueError, match="pickle"):
+        _get_array(OutArchive(ar.get_buffer()))

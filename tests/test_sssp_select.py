@@ -1,0 +1,91 @@
+"""Probe-and-pick SSSP (models/sssp_select.py): the host BFS hop probe
+must route low-diameter graphs to the dense pull and high-diameter
+graphs to delta-stepping, and the picked app must stay golden/oracle
+correct through the run_app driver."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import dataset_path
+from tests.verifiers import collect_worker_result, exact_verify, load_golden
+
+
+def _build_line_graph(n, fnum):
+    """A weighted path graph: diameter n-1 — the road-network regime."""
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.partitioner import SegmentedPartitioner
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+
+    src = np.arange(n - 1, dtype=np.int64)
+    dst = src + 1
+    w = np.ones(n - 1, dtype=np.float64)
+    oids = np.arange(n, dtype=np.int64)
+    vm = VertexMap.build(
+        oids, SegmentedPartitioner(fnum, oids), idxer_type="sorted_array"
+    )
+    return ShardedEdgecutFragment.build(
+        CommSpec(fnum=fnum), vm, src, dst, w,
+        directed=False, load_strategy=LoadStrategy.kBothOutIn,
+    )
+
+
+def test_probe_low_diameter_picks_dense(graph_cache):
+    from libgrape_lite_tpu.models.sssp_select import select_sssp_variant
+
+    frag = graph_cache(4)
+    picked, reason = select_sssp_variant(frag, 6)
+    assert picked == "sssp", reason
+    assert "hop levels" in reason
+
+
+def test_probe_high_diameter_picks_delta():
+    from libgrape_lite_tpu.models.sssp_select import select_sssp_variant
+
+    frag = _build_line_graph(512, 4)
+    picked, reason = select_sssp_variant(frag, 0)
+    assert picked == "sssp_delta", reason
+
+
+def test_probe_missing_source_is_dense(graph_cache):
+    from libgrape_lite_tpu.models.sssp_select import select_sssp_variant
+
+    frag = graph_cache(2)
+    picked, _ = select_sssp_variant(frag, 10**9)
+    assert picked == "sssp"
+
+
+def test_selected_delta_matches_dense_on_line_graph():
+    from libgrape_lite_tpu.models import SSSP, SSSPDelta
+    from libgrape_lite_tpu.models.sssp_select import host_bfs_levels
+
+    frag = _build_line_graph(300, 2)
+    levels, converged = host_bfs_levels(frag, 0, cap=64)
+    assert not converged  # the probe sees the live frontier at the cap
+
+    dense = collect_worker_result(SSSP(), frag, source=0)
+    delta = collect_worker_result(SSSPDelta(), frag, source=0)
+    assert dense == delta
+
+
+def test_run_app_sssp_select_golden(tmp_path):
+    """End-to-end through the driver: sssp_select on p2p-31 probes,
+    picks the dense path, and the output stays golden-exact."""
+    from libgrape_lite_tpu.runner import QueryArgs, run_app
+
+    out = tmp_path / "out"
+    run_app(QueryArgs(
+        application="sssp_select",
+        efile=dataset_path("p2p-31.e"),
+        vfile=dataset_path("p2p-31.v"),
+        sssp_source=6,
+        out_prefix=str(out),
+        fnum=4,
+    ))
+    got = {}
+    for f in out.iterdir():
+        for line in f.read_text().splitlines():
+            k, v = line.split()
+            got[int(k)] = v
+    exact_verify(got, load_golden(dataset_path("p2p-31-SSSP")))
