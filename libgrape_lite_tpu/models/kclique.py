@@ -87,19 +87,40 @@ class KClique(AppBase):
             self.total_cliques = int(per_apex.sum())
             return {"count": per_apex}
 
-        if k == 4 and self._oriented_dmax(frag) <= self.hub_cap:
-            # low-degeneracy graphs: the double-ring ELL kernel
-            from libgrape_lite_tpu.models.kclique_device import (
-                KClique4Device,
-            )
+        def run_device(app):
             from libgrape_lite_tpu.worker.worker import Worker
 
-            w = Worker(KClique4Device(), frag)
+            w = Worker(app, frag)
             w.query()
             per_apex = w.result_values()
             self.used_device_kernel = True
             self.total_cliques = int(per_apex.sum())
             return {"count": per_apex}
+
+        if k == 4 and self._oriented_dmax(frag) <= self.hub_cap:
+            # low-degeneracy graphs: the double-ring ELL kernel
+            from libgrape_lite_tpu.models.kclique_device import (
+                KClique4Device,
+            )
+
+            return run_device(KClique4Device())
+
+        if k >= 5 and self._oriented_dmax(frag) <= self.general_cap(k):
+            # general-k device kernel (all-gathered ELL, depth-(k-2)
+            # traced intersection); the work budget caps D so the
+            # d^(k-2) candidate tests per edge stay device-sized.
+            # Unlike the k=4 ring kernel, this one REPLICATES the
+            # hub-capped ELL per device — bill that gather against a
+            # budget so a huge low-degeneracy graph (road network)
+            # stays on the sharded host path instead of OOMing HBM
+            dmax = self._oriented_dmax(frag)
+            gather_bytes = (fnum * vp + 1) * (dmax + 1) * 4
+            if gather_bytes <= self._GATHER_BYTES_BUDGET:
+                from libgrape_lite_tpu.models.kclique_device import (
+                    KCliqueDevice,
+                )
+
+                return run_device(KCliqueDevice(k))
         self.used_device_kernel = False
 
         # global (dense-compacted) oriented adjacency from the host CSRs
@@ -171,6 +192,18 @@ class KClique(AppBase):
 
         self.total_cliques = int(counts.sum())
         return {"count": counts.reshape(fnum, vp)}
+
+    # per-edge candidate-test budget for the general-k device kernel:
+    # D^(k-2) <= _GENERAL_WORK_BUDGET picks the max admissible oriented
+    # out-degree per k (k=5: D<=80, k=6: D<=26, k=7: D<=13); beyond it
+    # the host recursion takes over, same as the over-cap k=4 case
+    _GENERAL_WORK_BUDGET = 1 << 19
+    # replicated-ELL ceiling for the general-k kernel's all_gather
+    # ((n_pad+1) x (D+1) int32 per device); ~2 GiB default
+    _GATHER_BYTES_BUDGET = 2 << 30
+
+    def general_cap(self, k: int) -> int:
+        return int(self._GENERAL_WORK_BUDGET ** (1.0 / (k - 2)))
 
     @staticmethod
     def _oriented_dmax(frag) -> int:

@@ -181,3 +181,58 @@ def test_k4_device_p2p31_parity(graph_cache):
     wh.query(k=4)
     assert dev.total_cliques == host.total_cliques
     np.testing.assert_array_equal(wd.result_values(), wh.result_values())
+
+
+@pytest.mark.parametrize("k", [5, 6])
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_general_k_device_kernel(k, fnum):
+    """The general-k device kernel (KCliqueDevice) must agree with the
+    host recursion per apex and brute force in total."""
+    from libgrape_lite_tpu.models import KClique
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    rng = np.random.default_rng(7)
+    n, e = 26, 150  # dense: plenty of 5/6-cliques
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    frag = build_fragment(src, dst, None, n, fnum)
+
+    dev_app = KClique()
+    w = Worker(dev_app, frag)
+    w.query(k=k)
+    assert dev_app.used_device_kernel, (
+        f"dmax {KClique._oriented_dmax(frag)} vs cap "
+        f"{dev_app.general_cap(k)}"
+    )
+    dev_counts = w.result_values()
+
+    host_app = KClique()
+    host_app.hub_cap = 0
+    host_app._GENERAL_WORK_BUDGET = 0  # force host recursion
+    w2 = Worker(host_app, frag)
+    w2.query(k=k)
+    assert not host_app.used_device_kernel
+    np.testing.assert_array_equal(dev_counts, w2.result_values())
+    assert dev_app.total_cliques == brute_force_kcliques(n, src, dst, k)
+
+
+def test_general_k4_matches_ring_kernel():
+    """KCliqueDevice(4) (all-gather form) must equal KClique4Device
+    (double-ring form) per apex — two independent device formulations."""
+    from libgrape_lite_tpu.models.kclique_device import (
+        KClique4Device,
+        KCliqueDevice,
+    )
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    rng = np.random.default_rng(13)
+    n, e = 40, 260
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    frag = build_fragment(src, dst, None, n, 2)
+
+    w1 = Worker(KCliqueDevice(4), frag)
+    w1.query()
+    w2 = Worker(KClique4Device(), frag)
+    w2.query()
+    np.testing.assert_array_equal(w1.result_values(), w2.result_values())
