@@ -25,6 +25,10 @@ def main():
     ap.add_argument("--edge_factor", type=int, default=16)
     ap.add_argument("--platform", default="default")
     ap.add_argument("--fnum", type=int, default=1)
+    ap.add_argument("--apps", default="",
+                    help="comma-filter by app name (default: all six)")
+    ap.add_argument("--graphs", default="",
+                    help="comma-filter by graph name (default: both)")
     args = ap.parse_args()
 
     if args.platform != "default":
@@ -86,8 +90,14 @@ def main():
         ("sssp_delta", lambda: SSSPDelta(), {"source": 6}),
     ]
 
+    app_filter = set(filter(None, args.apps.split(",")))
+    graph_filter = set(filter(None, args.graphs.split(",")))
     for gname, frag in graphs.items():
+        if graph_filter and gname not in graph_filter:
+            continue
         for aname, mk, kw in apps:
+            if app_filter and aname not in app_filter:
+                continue
             app = mk()
             w0 = Worker(app, frag)
             t0 = time.perf_counter()
