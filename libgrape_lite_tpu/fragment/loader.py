@@ -227,8 +227,15 @@ def _put_array(ar, a: np.ndarray) -> None:
         ar.add_scalar(planes.shape[0], "<b")
         for p in planes:
             raw = p.tobytes()
-            z = zlib.compress(raw, 1)
-            if len(z) < _PLANE_MIN_GAIN * len(raw):
+            # probe compressibility on a 1 MiB sample first: mantissa
+            # planes are noise, and paying a full-plane deflate just to
+            # discover that was 60% of the serialize phase (measured:
+            # 40.8 s of 67.6 s at 115M f64 weights)
+            sample = raw[: 1 << 20]
+            z = None
+            if len(zlib.compress(sample, 1)) < _PLANE_MIN_GAIN * len(sample):
+                z = zlib.compress(raw, 1)
+            if z is not None and len(z) < _PLANE_MIN_GAIN * len(raw):
                 ar.add_scalar(1, "<b")
                 ar.add_scalar(len(z))
                 ar.add_bytes(z)
