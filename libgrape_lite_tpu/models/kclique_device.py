@@ -41,6 +41,7 @@ class KClique4Device(LCCBeta):
     # low->high orientation: RMAT hubs keep only their few higher-degree
     # neighbors, so D stays under hub_cap (rmat16: 151 vs 6202 hi->lo)
     orientation = "lo"
+    uses_tiered_pass = False  # own edge walk; LCCBeta's schedule unused
 
     def init_state(self, frag, **kw):
         state = super().init_state(frag, **kw)
@@ -196,6 +197,7 @@ class KCliqueDevice(LCCBeta):
     result_format = "int"
     credit_mode = "apex"
     orientation = "lo"
+    uses_tiered_pass = False  # own edge walk; LCCBeta's schedule unused
 
     def __init__(self, k: int):
         if k < 4:

@@ -31,3 +31,19 @@ def test_lcc_beta_tiny_sharded():
         [w.result_values()[f, : frag.inner_vertices_num(f)] for f in range(4)]
     )
     np.testing.assert_allclose(vals, [1.0, 1.0, 1 / 3, 0.0], atol=1e-12)
+
+
+@pytest.mark.parametrize("fnum", [1, 4])
+def test_lcc_beta_tiered_golden(graph_cache, fnum, monkeypatch):
+    """Force tiny tier widths so the tiered merge passes (eperm
+    schedule + per-tier query widths) actually run on the test graph —
+    the default ladder exceeds small-graph d_max and would silently
+    disable tiering in CI."""
+    monkeypatch.setenv("GRAPE_LCC_TIERS", "2,8")
+    from libgrape_lite_tpu.models import LCCBeta
+
+    frag = graph_cache(fnum)
+    app = LCCBeta()
+    res = run_worker(app, frag)
+    assert app._tier_info is not None and len(app._tier_info) >= 2
+    eps_verify(res, load_golden(dataset_path("p2p-31-LCC")))
