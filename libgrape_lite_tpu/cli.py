@@ -49,6 +49,17 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--rebalance", action="store_true")
     p.add_argument("--rebalance_vertex_factor", type=int, default=0)
     p.add_argument("--memory_stats", action="store_true")
+    p.add_argument("--checkpoint_every", type=int, default=0,
+                   help="snapshot the query carry every K supersteps "
+                        "(ft/checkpoint.py; 0 = off; forces stepwise "
+                        "execution, requires --checkpoint_dir)")
+    p.add_argument("--checkpoint_dir", default="",
+                   help="directory for superstep checkpoints")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from the last complete checkpoint in "
+                        "--checkpoint_dir (query args replay from the "
+                        "checkpoint metadata; the config fingerprint "
+                        "must match)")
     p.add_argument("--profile", action="store_true",
                    help="stepwise rounds with per-round timing (PROFILING)")
     p.add_argument("--platform", default="",

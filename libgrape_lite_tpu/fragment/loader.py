@@ -204,8 +204,10 @@ def _put_array(ar, a: np.ndarray) -> None:
         # GRAPE_GARC_COMPACT=1 trades write time for bytes: deflating
         # the LEB128 payloads recovers v2's whole-archive ratio
         # (measured RMAT-18 weighted: 4.6 s / 45 MB vs the default
-        # 2.7 s / 59 MB vs v2's 7.5 s / 46 MB)
-        if os.environ.get("GRAPE_GARC_COMPACT") and len(enc) >= 1 << 12:
+        # 2.7 s / 59 MB vs v2's 7.5 s / 46 MB).  "0"/"" disable it,
+        # consistent with GRAPE_LCC_TIERS (ADVICE r5)
+        compact = os.environ.get("GRAPE_GARC_COMPACT", "") not in ("", "0")
+        if compact and len(enc) >= 1 << 12:
             import zlib
 
             z = zlib.compress(enc, 1)
@@ -252,6 +254,35 @@ def _put_array(ar, a: np.ndarray) -> None:
     ar.add_bytes(tag)
 
 
+def _bounded_decompress(buf: bytes, max_out: int) -> bytes:
+    """zlib.decompress with an output-size cap: the stream lengths in a
+    frag.garc are attacker-controlled, so an unbounded decompress would
+    let a small crafted cache file balloon into a huge allocation
+    before any length check runs (decompression bomb, ADVICE r5).  The
+    expected output size is always known to the caller; producing more
+    than that is by definition a corrupt stream."""
+    import zlib
+
+    d = zlib.decompressobj()
+    try:
+        # never pass 0 as max_length — zlib treats it as "no limit",
+        # which would reopen the bomb for streams claiming n=0; a
+        # 1-byte cap makes any output at all fail the check below
+        out = d.decompress(buf, max(1, max_out))
+        # input left over after the output cap was reached means the
+        # stream wants to produce more than the caller's bound; probe
+        # with a 1-byte cap (never ballooning) to confirm
+        extra = d.decompress(d.unconsumed_tail, 1) if d.unconsumed_tail else b""
+    except zlib.error as e:
+        raise ValueError(f"corrupt deflate stream in frag.garc: {e}") from e
+    if extra or len(out) > max_out:
+        raise ValueError(
+            "corrupt deflate stream in frag.garc: decompressed output "
+            f"exceeds the expected {max_out} bytes"
+        )
+    return out
+
+
 def _get_array(oa) -> np.ndarray:
     from libgrape_lite_tpu.utils.archive import (
         delta_varint_decode, varint_decode,
@@ -282,8 +313,6 @@ def _get_array(oa) -> np.ndarray:
         return out
     n = oa.get_scalar()
     if enc == _ENC_FPLANE:
-        import zlib
-
         from libgrape_lite_tpu.io.native import byte_join
 
         itemsize = oa.get_scalar("<b")
@@ -293,7 +322,8 @@ def _get_array(oa) -> np.ndarray:
             nbytes = oa.get_scalar()
             raw = bytes(oa.get_bytes(nbytes))
             if comp:
-                raw = zlib.decompress(raw)
+                # a plane is exactly n bytes; cap the inflate there
+                raw = _bounded_decompress(raw, n)
             if len(raw) != n:
                 raise ValueError("corrupt float plane in frag.garc")
             planes[p] = np.frombuffer(raw, dtype=np.uint8)
@@ -307,12 +337,12 @@ def _get_array(oa) -> np.ndarray:
             np.frombuffer(oa.get_bytes((n + 7) // 8), np.uint8)
         )[:n].astype(bool)
     elif enc in (_ENC_VARINT, _ENC_DELTA, _ENC_VARINT_Z, _ENC_DELTA_Z):
-        import zlib
-
         nbytes = oa.get_scalar()
         buf = bytes(oa.get_bytes(nbytes))
         if enc in (_ENC_VARINT_Z, _ENC_DELTA_Z):
-            buf = zlib.decompress(buf)
+            # LEB128 uses at most 10 bytes per uint64, so n elements
+            # bound the inflated payload at 10*n
+            buf = _bounded_decompress(buf, 10 * n)
         vals = (
             delta_varint_decode(buf) if enc in (_ENC_DELTA, _ENC_DELTA_Z)
             else varint_decode(buf)
@@ -366,14 +396,34 @@ def _serialize_fragment(frag: ShardedEdgecutFragment, cache: str, sig: str):
         f.write(sig)
 
 
+def _read_cache_file(path: str) -> bytes:
+    """Read one cache shard with the shared transient-IO retry policy
+    (ft/retry.py): serialization prefixes live on shared/network
+    filesystems where a stale-handle EIO is worth one more try before
+    falling back to a full rebuild from source text."""
+    from libgrape_lite_tpu.ft.retry import (
+        CACHE_READ_POLICY, is_transient_io_error, with_retries,
+    )
+
+    def _read():
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    return with_retries(
+        _read,
+        policy=CACHE_READ_POLICY,
+        retryable=is_transient_io_error,
+        describe=f"garc cache read {path}",
+    )
+
+
 def _read_garc(cache: str):
     """Parse frag.garc -> (meta dict, per-fragment streams)."""
     import zlib
 
     from libgrape_lite_tpu.utils.archive import OutArchive
 
-    with open(os.path.join(cache, "frag.garc"), "rb") as fh:
-        blob = fh.read()
+    blob = _read_cache_file(os.path.join(cache, "frag.garc"))
     # v3 containers start with the raw GARC magic; v2 wrapped the whole
     # archive in one deflate stream (first byte 0x78)
     if not blob.startswith((_GARC_MAGIC).to_bytes(8, "little")):
@@ -488,9 +538,21 @@ def _deserialize_fragment(
     # can't execute code.  string_id=True legacy caches therefore
     # require a trusted serialization_prefix — re-serialize to get the
     # pickle-free garc format.
-    z = np.load(
-        os.path.join(cache, "frag.npz"), allow_pickle=bool(spec.string_id)
+    # retry only the open (where stale network-FS handles bite); the
+    # file object keeps np.load's lazy per-member reads — buffering the
+    # whole multi-GB archive would double peak RSS at RMAT-24 scale
+    from libgrape_lite_tpu.ft.retry import (
+        CACHE_READ_POLICY, is_transient_io_error, with_retries,
     )
+
+    npz_path = os.path.join(cache, "frag.npz")
+    fh = with_retries(
+        lambda: open(npz_path, "rb"),
+        policy=CACHE_READ_POLICY,
+        retryable=is_transient_io_error,
+        describe=f"npz cache open {npz_path}",
+    )
+    z = np.load(fh, allow_pickle=bool(spec.string_id))
     fnum = int(z["fnum"])
     if fnum != comm_spec.fnum:
         raise ValueError(

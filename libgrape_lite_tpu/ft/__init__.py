@@ -1,0 +1,56 @@
+"""Fault-tolerance subsystem: superstep checkpoint/restore, fault
+injection, and retry/backoff.
+
+The PIE model makes a superstep boundary a consistent cut of the whole
+computation (every shard has voted, no collective is in flight), so
+durable fault tolerance costs one host snapshot of the query carry
+pytree per cadence interval:
+
+* `checkpoint` — `CheckpointManager` writes double-buffered, checksummed
+  snapshots of the carry + round counter + config fingerprint;
+  `restore_latest` walks them newest-first, rejecting fingerprint
+  mismatches and skipping corrupt shards.
+* `fingerprint` — the identity of a query (app, fragment content, mesh
+  shape, query args, numeric config) that a checkpoint must match to be
+  resumable with byte-identical results.
+* `faults` — `FaultPlan`, an env/CLI-driven harness that kills the
+  process at superstep k, corrupts a checkpoint shard, or clamps the
+  message capacity to force the overflow-retry path; recovery is tested,
+  not assumed (scripts/fault_drill.py).
+* `retry` — `with_retries`, the shared exponential-backoff policy with
+  typed retryable-error classification, wrapped around
+  `jax.distributed.initialize` (parallel/comm_spec.py) and garc cache
+  reads (fragment/loader.py).
+"""
+
+from libgrape_lite_tpu.ft.checkpoint import (
+    CheckpointManager,
+    CheckpointMismatchError,
+    CorruptCheckpointError,
+    restore_latest,
+)
+from libgrape_lite_tpu.ft.faults import FaultPlan, InjectedFault, active_plan
+from libgrape_lite_tpu.ft.fingerprint import compute_fingerprint
+from libgrape_lite_tpu.ft.retry import (
+    RetryPolicy,
+    RetryableError,
+    is_transient_distributed_error,
+    is_transient_io_error,
+    with_retries,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "CheckpointMismatchError",
+    "CorruptCheckpointError",
+    "FaultPlan",
+    "InjectedFault",
+    "RetryPolicy",
+    "RetryableError",
+    "active_plan",
+    "compute_fingerprint",
+    "is_transient_distributed_error",
+    "is_transient_io_error",
+    "restore_latest",
+    "with_retries",
+]

@@ -1,0 +1,317 @@
+"""Superstep checkpoint/restore.
+
+A superstep boundary in the PIE model is a consistent cut: every shard
+has voted, no collective is in flight, and the entire query is the
+carry pytree + the round counter.  `CheckpointManager` snapshots that
+cut at a configurable cadence:
+
+* **async double-buffered offload** — `save_async` kicks per-leaf
+  device→host DMA (`copy_to_host_async`) and hands serialization to a
+  single writer thread, so the next K supersteps overlap the previous
+  write; at most one write is ever in flight (the double buffer), and
+  a new save waits for the previous one first.
+* **atomic commit** — a checkpoint is staged in a temp directory and
+  `os.rename`d into place; `meta.json` (inside the directory before
+  the rename) is the completeness marker.  A kill mid-write leaves
+  only a stale temp dir, never a half checkpoint.
+* **corruption detection** — `meta.json` records the sha256 of
+  `state.npz`; `restore_latest` walks checkpoints newest-first,
+  *rejects* fingerprint mismatches (wrong app/fragment/args — resuming
+  would silently compute garbage) and *skips* corrupt shards, falling
+  back to the previous complete superstep.
+* **retention** — the newest `keep` complete checkpoints survive
+  (default 2: the one being written can never orphan the last good
+  one).
+
+Layout: `<dir>/ckpt_<rounds:08d>/{state.npz, meta.json}`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import re
+import shutil
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from libgrape_lite_tpu.ft.fingerprint import fingerprint_mismatch
+from libgrape_lite_tpu.utils import logging as glog
+
+CKPT_FORMAT = 1
+_STEP_RE = re.compile(r"^ckpt_(\d{8})$")
+
+
+class CheckpointMismatchError(ValueError):
+    """The checkpoint belongs to a different computation (app, fragment
+    content, mesh shape, query args, or numeric config differ)."""
+
+
+class CorruptCheckpointError(ValueError):
+    """The checkpoint failed its integrity check (sha256 mismatch,
+    unreadable metadata, or missing leaves)."""
+
+
+def _step_path(directory: str, rounds: int) -> str:
+    return os.path.join(directory, f"ckpt_{rounds:08d}")
+
+
+def list_checkpoints(directory: str) -> List[Tuple[int, str]]:
+    """(rounds, path) of every *complete* checkpoint, ascending."""
+    out = []
+    try:
+        entries = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    for name in entries:
+        m = _STEP_RE.match(name)
+        path = os.path.join(directory, name)
+        if m and os.path.exists(os.path.join(path, "meta.json")):
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def read_meta(step_path: str) -> Dict[str, Any]:
+    try:
+        with open(os.path.join(step_path, "meta.json")) as fh:
+            meta = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CorruptCheckpointError(
+            f"unreadable checkpoint metadata in {step_path}: {e}"
+        ) from e
+    if meta.get("format") != CKPT_FORMAT:
+        raise CorruptCheckpointError(
+            f"unsupported checkpoint format {meta.get('format')!r} "
+            f"in {step_path}"
+        )
+    return meta
+
+
+def load_state(step_path: str, meta: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Read and integrity-check one checkpoint's state leaves."""
+    npz_path = os.path.join(step_path, "state.npz")
+    try:
+        with open(npz_path, "rb") as fh:
+            blob = fh.read()
+    except OSError as e:
+        raise CorruptCheckpointError(
+            f"unreadable checkpoint shard {npz_path}: {e}"
+        ) from e
+    digest = hashlib.sha256(blob).hexdigest()
+    if digest != meta.get("npz_sha256"):
+        raise CorruptCheckpointError(
+            f"checkpoint shard {npz_path} failed its integrity check "
+            f"(sha256 {digest[:12]}… != recorded "
+            f"{str(meta.get('npz_sha256'))[:12]}…)"
+        )
+    try:
+        with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+            state = {k: z[k] for k in z.files}
+    except (ValueError, OSError, KeyError) as e:
+        raise CorruptCheckpointError(
+            f"undecodable checkpoint shard {npz_path}: {e}"
+        ) from e
+    manifest = meta.get("leaves", {})
+    if set(state) != set(manifest):
+        raise CorruptCheckpointError(
+            f"checkpoint shard {npz_path} leaf set "
+            f"{sorted(state)} != manifest {sorted(manifest)}"
+        )
+    return state
+
+
+def latest_meta(directory: str) -> Dict[str, Any]:
+    """Metadata of the newest complete checkpoint (for replaying query
+    args before the fragment-dependent restore).  Checkpoints with
+    unreadable metadata are skipped, mirroring `restore_latest`."""
+    steps = list_checkpoints(directory)
+    if not steps:
+        raise FileNotFoundError(
+            f"no complete checkpoint under {directory!r}"
+        )
+    last_err: Optional[Exception] = None
+    for _, path in reversed(steps):
+        try:
+            return read_meta(path)
+        except CorruptCheckpointError as e:
+            glog.log_info(f"skipping corrupt checkpoint {path}: {e}")
+            last_err = e
+    raise CorruptCheckpointError(
+        f"every checkpoint under {directory!r} has unreadable metadata; "
+        f"last error: {last_err}"
+    )
+
+
+def restore_latest(
+    directory: str, expected_fingerprint: Dict[str, Any]
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """(state, meta) of the newest usable checkpoint.
+
+    Fingerprint mismatches raise `CheckpointMismatchError` immediately
+    (resuming a different computation is never safe); corrupt shards
+    are skipped with a warning, falling back to the previous complete
+    superstep."""
+    steps = list_checkpoints(directory)
+    if not steps:
+        raise FileNotFoundError(
+            f"no complete checkpoint under {directory!r}"
+        )
+    last_err: Optional[Exception] = None
+    for rounds, path in reversed(steps):
+        try:
+            meta = read_meta(path)
+        except CorruptCheckpointError as e:
+            glog.log_info(f"skipping corrupt checkpoint {path}: {e}")
+            last_err = e
+            continue
+        found = meta.get("fingerprint", {})
+        diffs = fingerprint_mismatch(expected_fingerprint, found)
+        if diffs:
+            raise CheckpointMismatchError(
+                f"checkpoint {path} does not match this query: "
+                + "; ".join(diffs)
+            )
+        try:
+            state = load_state(path, meta)
+        except CorruptCheckpointError as e:
+            glog.log_info(f"skipping corrupt checkpoint {path}: {e}")
+            last_err = e
+            continue
+        return state, meta
+    raise CorruptCheckpointError(
+        f"every checkpoint under {directory!r} is corrupt; last error: "
+        f"{last_err}"
+    )
+
+
+class CheckpointManager:
+    """Writes double-buffered superstep checkpoints for one query."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        fingerprint: Dict[str, Any],
+        query_args: Dict[str, Any],
+        checkpoint_every: int,
+        keep: int = 2,
+        fresh_start: bool = False,
+    ):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.directory = directory
+        self.fingerprint = fingerprint
+        self.query_args = query_args
+        self.checkpoint_every = checkpoint_every
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+        # a kill mid-write leaves a .tmp-<rounds>-<pid> staging dir
+        # behind (different pid on resume, so the per-write cleanup
+        # never matches it) — sweep them all here
+        for name in os.listdir(directory):
+            if name.startswith(".tmp-"):
+                shutil.rmtree(
+                    os.path.join(directory, name), ignore_errors=True
+                )
+        if fresh_start:
+            # a NEW query (not a resume) starts a new checkpoint
+            # lineage: stale higher-round checkpoints from a previous
+            # run would otherwise shadow this run's fresh snapshots in
+            # both _gc's round-ordered retention and restore_latest's
+            # newest-first walk
+            for _, path in list_checkpoints(directory):
+                shutil.rmtree(path, ignore_errors=True)
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="grape-ckpt"
+        )
+        self._pending: Optional[Future] = None
+
+    # ---- save ------------------------------------------------------------
+
+    def save_async(self, state: Dict[str, Any], rounds: int, active: int):
+        """Snapshot the carry at superstep `rounds` without blocking the
+        superstep loop: device→host copies are kicked asynchronously and
+        the serialization runs on the writer thread.  Waits only for the
+        *previous* write (double buffer)."""
+        self.wait()
+        for v in state.values():
+            # start the D2H DMA now; np.asarray on the writer thread
+            # then completes an already-running transfer
+            if hasattr(v, "copy_to_host_async"):
+                v.copy_to_host_async()
+        snap = dict(state)
+        self._pending = self._executor.submit(
+            self._write, snap, int(rounds), int(active)
+        )
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) is durable;
+        propagates writer-thread failures to the superstep loop."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
+
+    def close(self) -> None:
+        self.wait()
+        self._executor.shutdown(wait=True)
+
+    def _write(self, state: Dict[str, Any], rounds: int, active: int):
+        host: Dict[str, np.ndarray] = {}
+        for k, v in state.items():
+            a = np.asarray(v)
+            if a.dtype == object:
+                raise TypeError(
+                    f"state leaf {k!r} has object dtype and cannot be "
+                    "checkpointed without pickle (refused: a checkpoint "
+                    "must never execute code on restore)"
+                )
+            host[k] = a
+        buf = io.BytesIO()
+        np.savez(buf, **host)
+        blob = buf.getvalue()
+        meta = {
+            "format": CKPT_FORMAT,
+            "rounds": rounds,
+            "active": active,
+            "checkpoint_every": self.checkpoint_every,
+            "fingerprint": self.fingerprint,
+            "query_args": self.query_args,
+            "leaves": {
+                k: {"shape": list(v.shape), "dtype": v.dtype.str}
+                for k, v in host.items()
+            },
+            "npz_sha256": hashlib.sha256(blob).hexdigest(),
+        }
+        final = _step_path(self.directory, rounds)
+        tmp = os.path.join(
+            self.directory, f".tmp-{rounds}-{os.getpid()}"
+        )
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, "state.npz"), "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        with open(os.path.join(tmp, "meta.json"), "w") as fh:
+            json.dump(meta, fh, indent=1, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        if os.path.exists(final):  # re-checkpoint of the same round
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        self._gc()
+        glog.vlog(
+            1,
+            f"checkpoint: superstep {rounds} -> {final} "
+            f"({len(blob)} bytes)",
+        )
+
+    def _gc(self) -> None:
+        steps = list_checkpoints(self.directory)
+        for _, path in steps[: max(0, len(steps) - self.keep)]:
+            shutil.rmtree(path, ignore_errors=True)

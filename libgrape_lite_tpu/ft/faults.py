@@ -1,0 +1,158 @@
+"""Fault injection: recovery is tested, not assumed.
+
+A `FaultPlan` describes the faults to inject into a query, driven by
+the `GRAPE_FT_FAULTS` env var (so `scripts/fault_drill.py` can arm a
+child process without code changes) or constructed directly in tests.
+
+Spec grammar — comma-separated tokens:
+
+    kill@K        kill the process after superstep K's checkpoint is
+                  durable (os._exit; `mode=raise` raises InjectedFault
+                  instead, for in-process tests)
+    corrupt@K     flip bytes in the newest checkpoint shard after the
+                  superstep-K checkpoint lands (exercises the
+                  corrupt-shard fallback on resume)
+    capacity=N    clamp the planned all_to_all message capacity to N,
+                  forcing the overflow vote + capacity-retry ladder
+                  (message_manager.plan_initial_capacity)
+    mode=raise    kill via InjectedFault instead of os._exit
+    exit=N        exit code for the kill (default 17)
+
+Example drill: `GRAPE_FT_FAULTS=kill@4` then resume from the same
+checkpoint dir — the resumed run must be byte-identical to an
+uninterrupted one.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from libgrape_lite_tpu.utils import logging as glog
+
+FAULTS_ENV = "GRAPE_FT_FAULTS"
+DEFAULT_KILL_EXIT_CODE = 17
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected fault (mode=raise kills)."""
+
+
+def corrupt_file(path: str, nbytes: int = 16, offset: Optional[int] = None):
+    """Flip `nbytes` bytes mid-file — a truncation-free corruption that
+    only a content checksum can catch."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot corrupt empty file {path}")
+    nbytes = min(nbytes, size)
+    if offset is None:
+        offset = max(0, size // 2 - nbytes // 2)
+    with open(path, "r+b") as fh:
+        fh.seek(offset)
+        chunk = fh.read(nbytes)
+        fh.seek(offset)
+        fh.write(bytes(b ^ 0xFF for b in chunk))
+
+
+@dataclass
+class FaultPlan:
+    kill_at_superstep: Optional[int] = None
+    corrupt_checkpoint_at: Optional[int] = None
+    capacity_clamp: Optional[int] = None
+    mode: str = "exit"  # exit | raise
+    exit_code: int = DEFAULT_KILL_EXIT_CODE
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        plan = cls()
+        for tok in filter(None, (t.strip() for t in spec.split(","))):
+            if tok.startswith("kill@"):
+                plan.kill_at_superstep = int(tok[len("kill@"):])
+            elif tok.startswith("corrupt@"):
+                plan.corrupt_checkpoint_at = int(tok[len("corrupt@"):])
+            elif tok.startswith("capacity="):
+                plan.capacity_clamp = max(1, int(tok[len("capacity="):]))
+            elif tok.startswith("mode="):
+                mode = tok[len("mode="):]
+                if mode not in ("exit", "raise"):
+                    raise ValueError(f"unknown fault kill mode {mode!r}")
+                plan.mode = mode
+            elif tok.startswith("exit="):
+                plan.exit_code = int(tok[len("exit="):])
+            else:
+                raise ValueError(
+                    f"unknown fault token {tok!r} in {FAULTS_ENV} "
+                    "(grammar: kill@K, corrupt@K, capacity=N, "
+                    "mode=raise, exit=N)"
+                )
+        return plan
+
+    @classmethod
+    def from_env(cls, environ=None) -> "FaultPlan":
+        return cls.from_spec((environ or os.environ).get(FAULTS_ENV, ""))
+
+    def is_noop(self) -> bool:
+        return (
+            self.kill_at_superstep is None
+            and self.corrupt_checkpoint_at is None
+            and self.capacity_clamp is None
+        )
+
+    # ---- hook points -----------------------------------------------------
+
+    def clamp_capacity(self, cap: int) -> int:
+        """plan_initial_capacity hook: force a capacity small enough to
+        overflow so the retry ladder actually runs."""
+        if self.capacity_clamp is None:
+            return cap
+        clamped = max(1, min(cap, self.capacity_clamp))
+        if clamped != cap:
+            glog.log_info(
+                f"fault injection: message capacity clamped "
+                f"{cap} -> {clamped}"
+            )
+        return clamped
+
+    def on_superstep(self, rounds: int, manager=None) -> None:
+        """Called by the stepwise worker after superstep `rounds` (and
+        its checkpoint save, if any) completes."""
+        if (
+            self.corrupt_checkpoint_at is not None
+            and rounds == self.corrupt_checkpoint_at
+            and manager is not None
+        ):
+            from libgrape_lite_tpu.ft.checkpoint import list_checkpoints
+
+            manager.wait()  # the shard must exist before we can maul it
+            steps = list_checkpoints(manager.directory)
+            if steps:
+                shard = os.path.join(steps[-1][1], "state.npz")
+                corrupt_file(shard)
+                glog.log_info(
+                    f"fault injection: corrupted checkpoint shard {shard}"
+                )
+        if (
+            self.kill_at_superstep is not None
+            and rounds == self.kill_at_superstep
+        ):
+            if manager is not None:
+                manager.wait()  # kill only after the checkpoint is durable
+            glog.log_info(
+                f"fault injection: killing at superstep {rounds} "
+                f"(mode={self.mode})"
+            )
+            if self.mode == "raise":
+                raise InjectedFault(f"injected kill at superstep {rounds}")
+            os._exit(self.exit_code)
+
+
+_NOOP = FaultPlan()
+
+
+def active_plan() -> FaultPlan:
+    """The env-armed plan (a no-op plan when GRAPE_FT_FAULTS is unset)."""
+    spec = os.environ.get(FAULTS_ENV, "")
+    if not spec:
+        return _NOOP
+    return FaultPlan.from_spec(spec)

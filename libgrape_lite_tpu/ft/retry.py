@@ -1,0 +1,166 @@
+"""Retry/backoff policy with typed retryable-error classification.
+
+The reference runtime is fail-fast (one MPI_Init attempt, one fread per
+cache shard); at the scale the ROADMAP targets, coordinator hiccups and
+flaky network filesystems are routine, so the transient subset of those
+failures gets a bounded exponential-backoff retry instead.  One policy
+object serves every call site — `jax.distributed.initialize`
+(parallel/comm_spec.py) and garc cache reads (fragment/loader.py) — so
+backoff behavior never diverges between subsystems.
+
+Classification is explicit: a call site passes a `retryable` predicate
+(or raises `RetryableError` itself); anything the predicate rejects
+propagates unchanged on the first attempt.  Retrying an error you
+cannot classify is how double-initialization bugs get hidden.
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from libgrape_lite_tpu.utils import logging as glog
+
+
+class RetryableError(Exception):
+    """Wrap an error a caller positively knows to be transient."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with bounded jitter.
+
+    Delay before retry i (0-based) is
+    `min(base_delay * multiplier**i, max_delay)`, scaled by a uniform
+    factor in [1 - jitter, 1 + jitter] (decorrelates retry storms when
+    many workers lose the same coordinator at once)."""
+
+    max_attempts: int = 3
+    base_delay: float = 0.5
+    multiplier: float = 2.0
+    max_delay: float = 30.0
+    jitter: float = 0.1
+
+    def delay(self, attempt: int, rng: Optional[random.Random] = None) -> float:
+        d = min(self.base_delay * self.multiplier ** attempt, self.max_delay)
+        if self.jitter and rng is not None:
+            d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(0.0, d)
+
+
+#: initialization-path default: a failed coordinator handshake is worth
+#: ~3 attempts over ~10 s before giving up the whole job
+DISTRIBUTED_INIT_POLICY = RetryPolicy(max_attempts=3, base_delay=2.0)
+
+#: cache-read default: short, cheap — the loader can always fall back
+#: to rebuilding from source text
+CACHE_READ_POLICY = RetryPolicy(max_attempts=3, base_delay=0.2, max_delay=2.0)
+
+
+def with_retries(
+    fn: Callable,
+    *,
+    policy: RetryPolicy = RetryPolicy(),
+    retryable: Optional[Callable[[BaseException], bool]] = None,
+    describe: str = "",
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+):
+    """Call `fn()` under `policy`.
+
+    An exception is retried iff it is a `RetryableError` or the
+    `retryable` predicate returns True for it; everything else (and the
+    final exhausted attempt) propagates unchanged."""
+    if policy.max_attempts < 1:
+        raise ValueError(f"max_attempts must be >= 1, got {policy.max_attempts}")
+    if rng is None and policy.jitter:
+        rng = random.Random()
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classification below
+            transient = isinstance(e, RetryableError) or (
+                retryable is not None and retryable(e)
+            )
+            if not transient or attempt + 1 >= policy.max_attempts:
+                raise
+            d = policy.delay(attempt, rng)
+            glog.log_info(
+                f"retry {attempt + 1}/{policy.max_attempts - 1}"
+                f"{' of ' + describe if describe else ''} in {d:.2f}s "
+                f"after {type(e).__name__}: {e}"
+            )
+            sleep(d)
+    raise AssertionError("unreachable")  # loop always returns or raises
+
+
+# ---- classifiers ---------------------------------------------------------
+
+#: phrases jax's distributed runtime uses for contract violations (a
+#: late or duplicate initialize) — never transient, never retried
+LATE_INIT_PHRASES = (
+    "must be called before",
+    "before any JAX",
+    "already initialized",
+    "Distributed initialization should be called before",
+)
+
+#: phrases the coordinator client surfaces for transient transport
+#: failures (gRPC status names ride through the RuntimeError text)
+_TRANSIENT_DIST_PHRASES = (
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "timed out",
+    "timeout",
+    "connection refused",
+    "connection reset",
+    "failed to connect",
+    "temporarily unavailable",
+)
+
+
+def is_late_init_error(exc: BaseException) -> bool:
+    """The caller violated the initialize-before-backend contract."""
+    msg = str(exc)
+    return isinstance(exc, RuntimeError) and any(
+        p.lower() in msg.lower() for p in LATE_INIT_PHRASES
+    )
+
+
+def is_transient_distributed_error(exc: BaseException) -> bool:
+    """A coordinator handshake failure worth retrying."""
+    if is_late_init_error(exc):
+        return False
+    msg = str(exc).lower()
+    return isinstance(exc, (RuntimeError, ConnectionError, TimeoutError)) and (
+        isinstance(exc, (ConnectionError, TimeoutError))
+        or any(p.lower() in msg for p in _TRANSIENT_DIST_PHRASES)
+    )
+
+
+#: OSError subclasses that describe a *state* of the filesystem, not a
+#: transient fault — retrying cannot change the outcome
+_PERMANENT_IO = (
+    FileNotFoundError,
+    IsADirectoryError,
+    NotADirectoryError,
+    PermissionError,
+)
+
+#: errnos seen from flaky network filesystems / stale NFS handles
+_TRANSIENT_ERRNOS = frozenset(
+    e for e in (
+        errno.EAGAIN, errno.EBUSY, errno.EIO, errno.ESTALE,
+        errno.ETIMEDOUT, errno.EINTR,
+    ) if e is not None
+)
+
+
+def is_transient_io_error(exc: BaseException) -> bool:
+    """A cache-read failure worth retrying (flaky shared filesystem)."""
+    if not isinstance(exc, OSError) or isinstance(exc, _PERMANENT_IO):
+        return False
+    return exc.errno is None or exc.errno in _TRANSIENT_ERRNOS

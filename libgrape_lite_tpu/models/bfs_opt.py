@@ -38,6 +38,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from libgrape_lite_tpu import compat
 from libgrape_lite_tpu.app.base import resolve_source
 from libgrape_lite_tpu.models.exchange_base import (
     ExchangeAppBase,
@@ -109,7 +110,7 @@ class BFSOpt(ExchangeAppBase):
             n_f, m_f, m_u = self._stats(lf, new, fr2)
             return new[None], fr2[None], n_f, m_f, m_u, ovf
 
-        fn = jax.jit(jax.shard_map(push, **self._shard_spec(frag.comm_spec)))
+        fn = jax.jit(compat.shard_map(push, **self._shard_spec(frag.comm_spec)))
         per_frag[key] = fn
         return fn
 
@@ -137,7 +138,7 @@ class BFSOpt(ExchangeAppBase):
             n_f, m_f, m_u = self._stats(lf, new, fr2)
             return new[None], fr2[None], n_f, m_f, m_u, jnp.int32(0)
 
-        fn = jax.jit(jax.shard_map(pull, **self._shard_spec(frag.comm_spec)))
+        fn = jax.jit(compat.shard_map(pull, **self._shard_spec(frag.comm_spec)))
         per_frag["pull"] = fn
         return fn
 

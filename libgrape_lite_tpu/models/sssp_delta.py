@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from libgrape_lite_tpu import compat
 from libgrape_lite_tpu.app.base import resolve_source
 from libgrape_lite_tpu.models.exchange_base import (
     ExchangeAppBase,
@@ -107,7 +108,7 @@ class SSSPDelta(ExchangeAppBase):
             return new[None], pend2[None], n_near, n_pend, min_pend, ovf
 
         fn = jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 step, mesh=comm_spec.mesh,
                 in_specs=(P(FRAG_AXIS), P(FRAG_AXIS), P(FRAG_AXIS), P()),
                 out_specs=(P(FRAG_AXIS), P(FRAG_AXIS), P(), P(), P(), P()),

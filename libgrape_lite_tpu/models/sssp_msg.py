@@ -24,6 +24,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from libgrape_lite_tpu import compat
 from libgrape_lite_tpu.app.base import resolve_source
 from libgrape_lite_tpu.models.exchange_base import (
     ExchangeAppBase,
@@ -81,7 +82,7 @@ class SSSPMsg(ExchangeAppBase):
                 return new[None], ch2[None], active, ovf
 
             fn = jax.jit(
-                jax.shard_map(
+                compat.shard_map(
                     step, mesh=comm_spec.mesh,
                     in_specs=(P(FRAG_AXIS), P(FRAG_AXIS), P(FRAG_AXIS)),
                     out_specs=(P(FRAG_AXIS), P(FRAG_AXIS), P(), P()),

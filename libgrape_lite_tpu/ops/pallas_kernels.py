@@ -29,7 +29,9 @@ def _intersect_kernel(a_ref, b_ref, o_ref):
     a = a_ref[...]
     b = b_ref[...]
     cnt = lax.population_count(a & b).astype(jnp.int32)
-    o_ref[...] = cnt.sum(axis=1)
+    # pin the accumulator dtype: under x64, sum() promotes int32 to
+    # int64, which the int32 output ref rejects
+    o_ref[...] = cnt.sum(axis=1, dtype=jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("block", "interpret"))

@@ -150,15 +150,21 @@ def plan_initial_capacity(frag, requested: int | None, learned) -> int:
     capacity a previous query on this fragment settled at (`learned` is
     the app's per-fragment WeakKeyDictionary); else a graph-informed
     floor — the densest vertex must be able to push all its edges to a
-    single destination shard without overflowing round one."""
+    single destination shard without overflowing round one.
+
+    An armed fault plan (GRAPE_FT_FAULTS=capacity=N, ft/faults.py)
+    clamps the result so the overflow vote + retry ladder actually
+    executes in drills instead of being dead code on real graphs."""
+    from libgrape_lite_tpu.ft.faults import active_plan
+
     if requested:
-        return max(1, requested)
+        return active_plan().clamp_capacity(max(1, requested))
     if frag in learned:
-        return learned[frag]
+        return active_plan().clamp_capacity(learned[frag])
     max_deg = max(
         int(np.diff(c.indptr).max(initial=1)) for c in frag.host_oe
     )
     cap = 1024
     while cap < 2 * max_deg:
         cap *= 2
-    return cap
+    return active_plan().clamp_capacity(cap)

@@ -47,6 +47,9 @@ class QueryArgs:
     rebalance_vertex_factor: int = 0
     string_id: bool = False
     memory_stats: bool = False
+    checkpoint_every: int = 0  # ft/: superstep checkpoint cadence (0 = off)
+    checkpoint_dir: str = ""
+    resume: bool = False  # continue from the last complete checkpoint
     profile: bool = False
     serialize: bool = False
     deserialize: bool = False
@@ -89,6 +92,16 @@ def build_query_kwargs(app_name: str, args: QueryArgs) -> dict:
 
 
 def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
+    # flag-consistency checks fail in milliseconds, BEFORE the (possibly
+    # minutes-long) graph load
+    if (args.checkpoint_every or args.resume) and not args.checkpoint_dir:
+        raise ValueError(
+            "--checkpoint_every/--resume require --checkpoint_dir"
+        )
+    if args.checkpoint_dir and not (args.checkpoint_every or args.resume):
+        raise ValueError(
+            "--checkpoint_dir requires --checkpoint_every (or --resume)"
+        )
     name = args.application
     if args.vc and name == "pagerank":
         name = "pagerank_vc"  # reference run_app_vc.h:82-89
@@ -196,6 +209,21 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
 
             if glog._level < 1:
                 glog.set_vlog_level(1)  # --profile exists to show timings
+        if args.resume:
+            # query args replay from the checkpoint metadata (the
+            # fingerprint guarantees they match this invocation's app +
+            # fragment); a fresh cadence flag overrides the recorded one
+            worker.resume(
+                args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every or None,
+            )
+        elif args.checkpoint_every:
+            worker.query(
+                checkpoint_every=args.checkpoint_every,
+                checkpoint_dir=args.checkpoint_dir,
+                **kw,
+            )
+        elif args.profile and not getattr(app, "host_only", False):
             worker.query_stepwise(**kw)
         else:
             worker.query(**kw)

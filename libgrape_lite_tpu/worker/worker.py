@@ -30,6 +30,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from libgrape_lite_tpu import compat
 from libgrape_lite_tpu.app.base import AppBase, StepContext
 from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
 from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
@@ -57,9 +58,16 @@ class Worker:
     (`default_message_manager.h:156-166`, `ForceTerminate` +
     `TerminateInfo`): an app votes a NEGATIVE active value to abort;
     the psum carries it to every shard, the loop stops, and
-    `get_terminate_info()` reports the failure.  There is no
-    checkpoint-restart of in-flight queries — fail-fast, like the
-    reference."""
+    `get_terminate_info()` reports the failure.
+
+    Checkpoint-restart (ft/): `query(..., checkpoint_every=K,
+    checkpoint_dir=...)` degrades the fused loop to stepwise execution
+    and snapshots the carry pytree + round counter every K supersteps
+    (a superstep boundary is a consistent cut); `resume(dir)` validates
+    the config fingerprint and continues from the last complete
+    superstep with byte-identical results.  With checkpointing off
+    (the default) the fused `shard_map(while_loop)` path is untouched —
+    fail-fast, like the reference."""
 
     def __init__(self, app: AppBase, fragment: ShardedEdgecutFragment):
         self.app = app
@@ -149,7 +157,7 @@ class Worker:
             specs, squeezed = self._key_specs(state)
             carry_specs = {k: v for k, v in specs.items() if k not in eph}
             eph_specs = {k: v for k, v in specs.items() if k in eph}
-            sm = jax.shard_map(
+            sm = compat.shard_map(
                 partial(stepper, squeezed=squeezed),
                 mesh=mesh,
                 in_specs=(frag_spec, carry_specs, eph_specs),
@@ -178,8 +186,22 @@ class Worker:
             self._runner_cache[key] = self._make_runner(max_rounds)(state)
         return self._runner_cache[key]
 
-    def query(self, max_rounds: int | None = None, **query_args):
-        """Run one query (reference `Worker::Query`, worker.h:104-146)."""
+    def query(self, max_rounds: int | None = None, *,
+              checkpoint_every: int | None = None,
+              checkpoint_dir: str | None = None,
+              fault_plan=None, **query_args):
+        """Run one query (reference `Worker::Query`, worker.h:104-146).
+
+        `checkpoint_every=K` + `checkpoint_dir` degrade the fused loop
+        to stepwise execution with a carry snapshot every K supersteps
+        (ft/checkpoint.py); `checkpoint_every=None` (default) leaves
+        the fused `shard_map(while_loop)` fast path untouched."""
+        if checkpoint_every is not None or checkpoint_dir is not None:
+            return self.query_stepwise(
+                max_rounds, checkpoint_every=checkpoint_every,
+                checkpoint_dir=checkpoint_dir, fault_plan=fault_plan,
+                **query_args,
+            )
         app = self.app
         frag = self.fragment
         mr = app.max_rounds if max_rounds is None else max_rounds
@@ -252,19 +274,25 @@ class Worker:
             return _unsqueeze_state(s2, squeezed), jnp.int32(active)
 
         return jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 fn, mesh=mesh, in_specs=(frag_spec, specs),
                 out_specs=(out_specs, P()), check_vma=False,
             )
         )
 
-    def query_stepwise(self, max_rounds: int | None = None, **query_args):
+    def query_stepwise(self, max_rounds: int | None = None, *,
+                       checkpoint_every: int | None = None,
+                       checkpoint_dir: str | None = None,
+                       fault_plan=None, _resume: bool = False,
+                       **query_args):
         """Host-driven query: one jitted superstep per round with
         per-round wall time + termination-vote logs — the observable
         behavior of the reference's coordinator logs (`worker.h:120-139`)
         and -DPROFILING timers.  Also the execution mode for
         MutationContext apps (`query` routes them here), since the graph
-        can be rebuilt between rounds.  Slower than the fused `query`
+        can be rebuilt between rounds, and for checkpointed queries
+        (`checkpoint_every=K` snapshots the carry pytree every K
+        supersteps via ft/checkpoint.py).  Slower than the fused `query`
         (host sync per round); results are identical for mutation-free
         apps."""
         import time
@@ -273,26 +301,124 @@ class Worker:
 
         app = self.app
         frag = self.fragment
+        has_mutations = hasattr(app, "collect_mutations")
+        if checkpoint_dir and checkpoint_every is None and not _resume:
+            raise ValueError(
+                "checkpoint_dir requires checkpoint_every (a dir alone "
+                "would run stepwise while writing no snapshots); to "
+                "continue a previous run use Worker.resume"
+            )
+        checkpointing = checkpoint_every is not None or _resume
+        if checkpointing:
+            if getattr(app, "host_only", False):
+                raise ValueError(
+                    "checkpointing requires the superstep path; "
+                    f"{type(app).__name__} is a host-only app"
+                )
+            if has_mutations:
+                raise ValueError(
+                    "checkpointing MutationContext apps is not supported "
+                    "(the fragment itself changes between rounds)"
+                )
+            if not checkpoint_dir:
+                raise ValueError("checkpoint_every requires checkpoint_dir")
+            if checkpoint_every is not None and checkpoint_every <= 0:
+                raise ValueError(
+                    f"checkpoint_every must be >= 1, got {checkpoint_every}"
+                )
+            if jax.process_count() > 1:
+                # the writer snapshots the carry with np.asarray, which
+                # requires fully-addressable arrays; multi-host needs
+                # per-process shard files + a commit barrier (ROADMAP)
+                raise NotImplementedError(
+                    "superstep checkpointing is single-host for now: the "
+                    "carry spans non-addressable devices in a "
+                    "jax.distributed run"
+                )
         if getattr(app, "host_only", False):
             return self.query(max_rounds, **query_args)
         mr = app.max_rounds if max_rounds is None else max_rounds
         if mr <= 0:
             mr = _INT32_MAX
 
-        state = self._place_state(app.init_state(frag, **query_args))
-        peval_fn = self._compile_single_step("peval", state)
+        if fault_plan is None:
+            from libgrape_lite_tpu.ft.faults import active_plan
+
+            fault_plan = active_plan()
+        if fault_plan.is_noop():
+            fault_plan = None
+
+        state_np = app.init_state(frag, **query_args)
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        ckpt = None
+        resume_meta = None
+        if checkpointing:
+            from libgrape_lite_tpu.ft.checkpoint import (
+                CheckpointManager, CheckpointMismatchError, restore_latest,
+            )
+            from libgrape_lite_tpu.ft.fingerprint import (
+                canonical_query_args, compute_fingerprint,
+            )
+
+            fingerprint = compute_fingerprint(app, frag, query_args)
+            if _resume:
+                restored, resume_meta = restore_latest(
+                    checkpoint_dir, fingerprint
+                )
+                carry_keys = {k for k in state_np if k not in eph}
+                if set(restored) != carry_keys:
+                    raise CheckpointMismatchError(
+                        f"checkpoint carry keys {sorted(restored)} != "
+                        f"this query's carry keys {sorted(carry_keys)}"
+                    )
+                state_np = {**state_np, **restored}
+                if checkpoint_every is None:
+                    checkpoint_every = (
+                        resume_meta.get("checkpoint_every") or None
+                    )
+            if checkpoint_every is not None:
+                ckpt = CheckpointManager(
+                    checkpoint_dir,
+                    fingerprint=fingerprint,
+                    query_args=canonical_query_args(query_args),
+                    checkpoint_every=checkpoint_every,
+                    # a new query starts a new lineage; stale
+                    # checkpoints in a reused dir must not shadow it
+                    fresh_start=not _resume,
+                )
+
+        state = self._place_state(state_np)
         inc_fn = self._compile_single_step("inceval", state)
         # ephemeral leaves drop out of each step's outputs; re-merge the
         # placed originals so the next step's inputs stay complete
-        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
         eph_vals = {k: state[k] for k in eph}
 
-        t0 = time.perf_counter()
-        state, active = jax.block_until_ready(peval_fn(frag.dev, state))
-        state = {**state, **eph_vals}
-        glog.vlog(1, f"PEval: {time.perf_counter() - t0:.6f}s active={int(active)}")
-        rounds = 0
-        has_mutations = hasattr(app, "collect_mutations")
+        def carry_of(st):
+            return {k: v for k, v in st.items() if k not in eph}
+
+        if resume_meta is not None:
+            rounds = int(resume_meta["rounds"])
+            active = np.int32(resume_meta["active"])
+            glog.vlog(
+                1,
+                f"resumed from superstep {rounds} "
+                f"(active={int(active)}, dir={checkpoint_dir})",
+            )
+        else:
+            peval_fn = self._compile_single_step("peval", state)
+            t0 = time.perf_counter()
+            state, active = jax.block_until_ready(peval_fn(frag.dev, state))
+            state = {**state, **eph_vals}
+            glog.vlog(
+                1, f"PEval: {time.perf_counter() - t0:.6f}s active={int(active)}"
+            )
+            rounds = 0
+            if ckpt is not None:
+                # a superstep-0 snapshot always exists, so a kill at any
+                # later round has something to fall back to
+                ckpt.save_async(carry_of(state), 0, int(active))
+            if fault_plan is not None:
+                fault_plan.on_superstep(0, ckpt)
 
         def apply_mutations_if_any(state, frag, inc_fn, rounds):
             host_state = {
@@ -324,36 +450,83 @@ class Worker:
                 eph_vals = {k: state[k] for k in eph}
             if changed and int(active) >= 0:
                 active = 1
-        while int(active) > 0 and rounds < mr:
-            t0 = time.perf_counter()
-            state, active = jax.block_until_ready(inc_fn(frag.dev, state))
-            state = {**state, **eph_vals}
-            rounds += 1
-            glog.vlog(
-                1,
-                f"IncEval round {rounds}: {time.perf_counter() - t0:.6f}s "
-                f"active={int(active)}",
-            )
-            if has_mutations:
-                # MutationContext path (reference worker.h:211-222);
-                # never overrides a ForceTerminate vote
-                state, frag, inc_fn, changed = apply_mutations_if_any(
-                    state, frag, inc_fn, rounds
+        try:
+            while int(active) > 0 and rounds < mr:
+                t0 = time.perf_counter()
+                state, active = jax.block_until_ready(
+                    inc_fn(frag.dev, state)
                 )
-                if changed:
-                    eph_vals = {k: state[k] for k in eph}
-                if changed and int(active) >= 0:
-                    active = 1  # the new topology must be re-evaluated
-                    if rounds >= mr:
-                        glog.log_info(
-                            "mutation applied on the final permitted round; "
-                            "the rebuilt topology was NOT re-evaluated — "
-                            "raise max_rounds"
-                        )
+                state = {**state, **eph_vals}
+                rounds += 1
+                glog.vlog(
+                    1,
+                    f"IncEval round {rounds}: "
+                    f"{time.perf_counter() - t0:.6f}s active={int(active)}",
+                )
+                if ckpt is not None and rounds % checkpoint_every == 0:
+                    ckpt.save_async(carry_of(state), rounds, int(active))
+                if fault_plan is not None:
+                    fault_plan.on_superstep(rounds, ckpt)
+                if has_mutations:
+                    # MutationContext path (reference worker.h:211-222);
+                    # never overrides a ForceTerminate vote
+                    state, frag, inc_fn, changed = apply_mutations_if_any(
+                        state, frag, inc_fn, rounds
+                    )
+                    if changed:
+                        eph_vals = {k: state[k] for k in eph}
+                    if changed and int(active) >= 0:
+                        active = 1  # the new topology must be re-evaluated
+                        if rounds >= mr:
+                            glog.log_info(
+                                "mutation applied on the final permitted "
+                                "round; the rebuilt topology was NOT "
+                                "re-evaluated — raise max_rounds"
+                            )
+        finally:
+            # flush the in-flight snapshot even on an exception (an
+            # injected raise-mode kill must leave a durable checkpoint)
+            if ckpt is not None:
+                ckpt.close()
         self.rounds = rounds
         self._terminate_code = min(0, int(active))
         self._result_state = state
         return state
+
+    def resume(self, checkpoint_dir: str, max_rounds: int | None = None, *,
+               checkpoint_every: int | None = None, fault_plan=None):
+        """Continue a checkpointed query from the last complete
+        superstep.  The config fingerprint (app, fragment content, mesh
+        shape, query args, numeric config) is validated before any
+        state is adopted — a mismatch raises `CheckpointMismatchError`;
+        a corrupt newest shard falls back to the previous complete
+        superstep.  Query args are replayed from checkpoint metadata,
+        so the resumed run finishes with byte-identical results to an
+        uninterrupted one.  Checkpointing continues at the recorded
+        cadence unless `checkpoint_every` overrides it."""
+        from libgrape_lite_tpu.ft.checkpoint import (
+            CheckpointMismatchError, latest_meta,
+        )
+        from libgrape_lite_tpu.ft.fingerprint import app_registry_name
+
+        meta = latest_meta(checkpoint_dir)
+        # reject a wrong-app resume BEFORE replaying its query args into
+        # this app's init_state (which would fail with an opaque
+        # TypeError instead of the fingerprint diagnosis)
+        recorded = (meta.get("fingerprint") or {}).get("app")
+        mine = app_registry_name(self.app)
+        if recorded is not None and recorded != mine:
+            raise CheckpointMismatchError(
+                f"checkpoint {checkpoint_dir!r} does not match this "
+                f"query: app: checkpoint has {recorded!r}, query has "
+                f"{mine!r}"
+            )
+        query_args = meta.get("query_args") or {}
+        return self.query_stepwise(
+            max_rounds, checkpoint_every=checkpoint_every,
+            checkpoint_dir=checkpoint_dir, fault_plan=fault_plan,
+            _resume=True, **query_args,
+        )
 
     # ---- Output / Assemble (reference worker.h:148-154, ctx.Output) ----
 

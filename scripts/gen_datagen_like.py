@@ -19,8 +19,8 @@ linear scale factor:
     communication ratio)
   * degree sequence: lognormal(sigma=1.15) scaled to the target mean,
     clipped to [1, 2000] (datagen fb's hub cutoff scale)
-  * community sizes: Zipf-like power law over ~n/150 communities,
-    clipped to [20, 50k]
+  * community sizes: Zipf-like power law over ~n/1500 communities,
+    clipped to [400, 50k]
   * wiring: configuration model — every vertex gets deg(v) stubs;
     80% of stubs pair WITHIN the community (sorted by (community,
     random), paired consecutively), 20% pair globally; self-loops and

@@ -42,6 +42,7 @@ def worker(pid: int, coord: str) -> None:
     # registers the axon plugin; env vars alone do not stop it)
     jax.config.update("jax_platforms", "cpu")
 
+    from libgrape_lite_tpu import compat
     from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS, CommSpec
 
     comm_spec = CommSpec.init_distributed(
@@ -79,7 +80,7 @@ def worker(pid: int, coord: str) -> None:
         return (passed + total)[None], total
 
     fn = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             step, mesh=comm_spec.mesh, in_specs=(P(FRAG_AXIS),),
             out_specs=(P(FRAG_AXIS), P()), check_vma=False,
         )

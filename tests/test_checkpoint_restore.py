@@ -1,0 +1,299 @@
+"""ft/ checkpoint-restore tests: kill at superstep k, resume, verify
+byte-identical results; fingerprint-mismatch rejection; corrupt-shard
+detection and fallback.  All CPU-runnable (quick lane); the real
+process-kill (os._exit) variant lives in scripts/fault_drill.py."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import dataset_path
+
+
+def _apps():
+    from libgrape_lite_tpu.models import CDLP, SSSP, PageRank
+
+    return {
+        "sssp": (SSSP, dict(source=6)),
+        "pagerank": (PageRank, dict(delta=0.85, max_round=10)),
+        "cdlp": (CDLP, dict(max_round=10)),
+    }
+
+
+def _run(worker, **kw):
+    worker.query(**kw)
+    return worker.result_values()
+
+
+@pytest.mark.parametrize("app_name", ["sssp", "pagerank", "cdlp"])
+def test_kill_at_superstep_resume_byte_identical(graph_cache, app_name, tmp_path):
+    """The acceptance drill, in-process (mode=raise kill): checkpoint ->
+    kill at superstep k -> resume -> byte-identical to an uninterrupted
+    run (and to the fused no-checkpoint path)."""
+    from libgrape_lite_tpu.ft.faults import FaultPlan, InjectedFault
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    app_cls, qa = _apps()[app_name]
+    frag = graph_cache(2)
+
+    ref = _run(
+        Worker(app_cls(), frag),
+        checkpoint_every=3, checkpoint_dir=str(tmp_path / "ref"), **qa,
+    )
+    fused = _run(Worker(app_cls(), frag), **qa)
+    np.testing.assert_array_equal(ref, fused)
+
+    kill_dir = str(tmp_path / "kill")
+    w_kill = Worker(app_cls(), frag)
+    with pytest.raises(InjectedFault):
+        w_kill.query(
+            checkpoint_every=3, checkpoint_dir=kill_dir,
+            fault_plan=FaultPlan(kill_at_superstep=4, mode="raise"), **qa,
+        )
+    # the kill fired only after a durable checkpoint existed
+    from libgrape_lite_tpu.ft.checkpoint import list_checkpoints
+
+    assert list_checkpoints(kill_dir), "kill left no complete checkpoint"
+
+    w_res = Worker(app_cls(), frag)
+    w_res.resume(kill_dir)
+    res = w_res.result_values()
+    assert res.tobytes() == ref.tobytes()
+
+
+def test_checkpoint_off_leaves_fused_path(graph_cache, monkeypatch):
+    """checkpoint_every=None must take the fused shard_map(while_loop)
+    path, never the stepwise one."""
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    w = Worker(SSSP(), frag)
+
+    def boom(*a, **k):
+        raise AssertionError("query_stepwise called with checkpointing off")
+
+    monkeypatch.setattr(w, "query_stepwise", boom)
+    w.query(source=6)
+    assert w._runner_cache, "fused runner was not compiled"
+
+
+def test_checkpoint_routes_to_stepwise(graph_cache, tmp_path):
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    w = Worker(SSSP(), frag)
+    w.query(checkpoint_every=5, checkpoint_dir=str(tmp_path / "ck"), source=6)
+    # the stepwise path compiles per-step functions, not the fused runner
+    assert not w._runner_cache
+    assert os.listdir(str(tmp_path / "ck"))
+
+
+def test_fingerprint_mismatch_rejected(graph_cache, tmp_path):
+    """A checkpoint from a different app or a different fragment
+    partitioning must be rejected, not silently resumed."""
+    from libgrape_lite_tpu.ft.checkpoint import CheckpointMismatchError
+    from libgrape_lite_tpu.models import SSSP, PageRank
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    ck = str(tmp_path / "ck")
+    _run(Worker(SSSP(), frag), checkpoint_every=3, checkpoint_dir=ck, source=6)
+
+    with pytest.raises(CheckpointMismatchError, match="app"):
+        Worker(PageRank(), frag).resume(ck)
+
+    with pytest.raises(CheckpointMismatchError, match="fnum|fragment"):
+        Worker(SSSP(), graph_cache(4)).resume(ck)
+
+
+def test_corrupt_shard_falls_back_then_fails(graph_cache, tmp_path):
+    """A corrupt newest shard falls back to the previous complete
+    superstep (still byte-identical); all shards corrupt is an error."""
+    from libgrape_lite_tpu.ft.checkpoint import (
+        CorruptCheckpointError, list_checkpoints,
+    )
+    from libgrape_lite_tpu.ft.faults import (
+        FaultPlan, InjectedFault, corrupt_file,
+    )
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    ref = _run(
+        Worker(SSSP(), frag),
+        checkpoint_every=3, checkpoint_dir=str(tmp_path / "ref"), source=6,
+    )
+
+    ck = str(tmp_path / "ck")
+    with pytest.raises(InjectedFault):
+        Worker(SSSP(), frag).query(
+            checkpoint_every=3, checkpoint_dir=ck,
+            fault_plan=FaultPlan(kill_at_superstep=7, mode="raise"),
+            source=6,
+        )
+    steps = list_checkpoints(ck)
+    assert len(steps) == 2  # double-buffered retention
+    corrupt_file(os.path.join(steps[-1][1], "state.npz"))
+
+    w = Worker(SSSP(), frag)
+    w.resume(ck)
+    assert w.result_values().tobytes() == ref.tobytes()
+
+    # resume completed and wrote fresh checkpoints; corrupt everything
+    for _, path in list_checkpoints(ck):
+        corrupt_file(os.path.join(path, "state.npz"))
+    with pytest.raises(CorruptCheckpointError):
+        Worker(SSSP(), frag).resume(ck)
+
+
+def test_corrupt_via_fault_plan(graph_cache, tmp_path):
+    """The corrupt@K fault token mauls the shard from inside the run."""
+    from libgrape_lite_tpu.ft.faults import FaultPlan, InjectedFault
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    ref = _run(
+        Worker(SSSP(), frag),
+        checkpoint_every=3, checkpoint_dir=str(tmp_path / "ref"), source=6,
+    )
+    ck = str(tmp_path / "ck")
+    plan = FaultPlan.from_spec("corrupt@6,kill@7,mode=raise")
+    with pytest.raises(InjectedFault):
+        Worker(SSSP(), frag).query(
+            checkpoint_every=3, checkpoint_dir=ck, fault_plan=plan, source=6,
+        )
+    w = Worker(SSSP(), frag)
+    w.resume(ck)
+    assert w.result_values().tobytes() == ref.tobytes()
+
+
+def test_checkpoint_guards(graph_cache, tmp_path):
+    """host-only and MutationContext apps, and malformed cadence/dir
+    combinations, fail loudly up front."""
+    from libgrape_lite_tpu.models import SSSP, KClique
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    with pytest.raises(ValueError, match="host-only"):
+        Worker(KClique(), frag).query(
+            checkpoint_every=2, checkpoint_dir=str(tmp_path / "a"), k=3
+        )
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        Worker(SSSP(), frag).query(checkpoint_every=2, source=6)
+    # the inverse is just as silent a failure mode: a dir alone would
+    # run stepwise while writing no snapshots
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        Worker(SSSP(), frag).query(
+            checkpoint_dir=str(tmp_path / "c"), source=6
+        )
+    with pytest.raises(ValueError, match=">= 1"):
+        Worker(SSSP(), frag).query(
+            checkpoint_every=0, checkpoint_dir=str(tmp_path / "b"), source=6
+        )
+    with pytest.raises(FileNotFoundError):
+        Worker(SSSP(), frag).resume(str(tmp_path / "nonexistent"))
+
+
+def test_reused_dir_starts_fresh_lineage(graph_cache, tmp_path):
+    """A NEW query into a dir holding stale (higher-round) checkpoints
+    must not let them shadow its own snapshots — the stale lineage is
+    wiped, and a kill + resume recovers THIS run, not the old one."""
+    from libgrape_lite_tpu.ft.checkpoint import list_checkpoints
+    from libgrape_lite_tpu.ft.faults import FaultPlan, InjectedFault
+    from libgrape_lite_tpu.models import SSSP, PageRank
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    ck = str(tmp_path / "ck")
+    # old lineage: SSSP runs to convergence (rounds ~22)
+    _run(Worker(SSSP(), frag), checkpoint_every=3, checkpoint_dir=ck,
+         source=6)
+    assert list_checkpoints(ck)
+
+    # new lineage in the SAME dir: PageRank, killed early
+    ref = _run(
+        Worker(PageRank(), frag),
+        checkpoint_every=2, checkpoint_dir=str(tmp_path / "ref"),
+        delta=0.85, max_round=10,
+    )
+    with pytest.raises(InjectedFault):
+        Worker(PageRank(), frag).query(
+            checkpoint_every=2, checkpoint_dir=ck,
+            fault_plan=FaultPlan(kill_at_superstep=5, mode="raise"),
+            delta=0.85, max_round=10,
+        )
+    # only the new run's checkpoints remain, and resume recovers it
+    rounds = [r for r, _ in list_checkpoints(ck)]
+    assert max(rounds) <= 5
+    w = Worker(PageRank(), frag)
+    w.resume(ck)
+    assert w.result_values().tobytes() == ref.tobytes()
+
+
+def test_stale_tmp_dirs_swept(graph_cache, tmp_path):
+    """.tmp-* staging dirs from a killed writer are swept at manager
+    startup (the resumed process has a different pid, so the per-write
+    cleanup can never match them)."""
+    import os as _os
+
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    stale = ck / ".tmp-3-99999"
+    stale.mkdir()
+    (stale / "state.npz").write_bytes(b"half-written")
+    _run(Worker(SSSP(), graph_cache(2)), checkpoint_every=3,
+         checkpoint_dir=str(ck), source=6)
+    assert not stale.exists()
+    assert all(
+        not n.startswith(".tmp-") for n in _os.listdir(str(ck))
+    )
+
+
+def test_capacity_fault_forces_overflow_recovery(monkeypatch):
+    """GRAPE_FT_FAULTS=capacity=N clamps the planned message capacity so
+    the overflow vote + retry ladder actually executes — and the query
+    still converges to the dense path's exact distances."""
+    from libgrape_lite_tpu.models import SSSP, SSSPMsg
+    from libgrape_lite_tpu.worker.worker import Worker
+    from tests.test_worker import build_fragment
+
+    rng = np.random.default_rng(1)
+    n, e = 64, 512
+    src, dst = rng.integers(0, n, e), rng.integers(0, n, e)
+    w = rng.random(e)
+    frag = build_fragment(src, dst, w, n, 2)
+
+    dense = Worker(SSSP(), frag)
+    dense.query(source=0)
+    want = dense.result_values()
+
+    monkeypatch.setenv("GRAPE_FT_FAULTS", "capacity=2")
+    app = SSSPMsg()
+    wk = Worker(app, frag)
+    wk.query(source=0)
+    assert app.retries > 0, "clamped capacity never overflowed"
+    np.testing.assert_array_equal(wk.result_values(), want)
+
+
+def test_resume_from_converged_checkpoint(graph_cache, tmp_path):
+    """Resuming a checkpoint whose active vote is already 0 finishes
+    immediately with the recorded state (idempotent resume)."""
+    from libgrape_lite_tpu.models import PageRank
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    ck = str(tmp_path / "ck")
+    ref = _run(
+        Worker(PageRank(), frag),
+        checkpoint_every=1, checkpoint_dir=ck, delta=0.85, max_round=10,
+    )
+    w = Worker(PageRank(), frag)
+    w.resume(ck)
+    assert w.result_values().tobytes() == ref.tobytes()

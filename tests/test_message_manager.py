@@ -8,6 +8,7 @@ def test_exchange_routes_messages():
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from libgrape_lite_tpu import compat
     from libgrape_lite_tpu.parallel.comm_spec import CommSpec, FRAG_AXIS
     from libgrape_lite_tpu.parallel.message_manager import AllToAllMessageManager
 
@@ -27,7 +28,7 @@ def test_exchange_routes_messages():
         return rl[None], rp[None], rv[None], ovf
 
     fn = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             step,
             mesh=cs.mesh,
             in_specs=(P(FRAG_AXIS), P(FRAG_AXIS), P(FRAG_AXIS), P(FRAG_AXIS)),
@@ -58,6 +59,7 @@ def test_exchange_overflow_flag():
     import jax
     from jax.sharding import PartitionSpec as P
 
+    from libgrape_lite_tpu import compat
     from libgrape_lite_tpu.parallel.comm_spec import CommSpec, FRAG_AXIS
     from libgrape_lite_tpu.parallel.message_manager import AllToAllMessageManager
 
@@ -75,7 +77,7 @@ def test_exchange_overflow_flag():
         return rl[None], rp[None], rv[None], ovf
 
     fn = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             step, mesh=cs.mesh,
             in_specs=(P(FRAG_AXIS),) * 4,
             out_specs=(P(FRAG_AXIS), P(FRAG_AXIS), P(FRAG_AXIS), P()),

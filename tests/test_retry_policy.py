@@ -1,0 +1,249 @@
+"""ft/retry tests: backoff schedule, jitter bounds, non-retryable
+passthrough, and the comm_spec init-failure classification."""
+
+import random
+
+import pytest
+
+
+def test_backoff_schedule():
+    from libgrape_lite_tpu.ft.retry import RetryPolicy, with_retries
+
+    sleeps = []
+    calls = []
+    policy = RetryPolicy(
+        max_attempts=5, base_delay=0.5, multiplier=2.0, max_delay=3.0,
+        jitter=0.0,
+    )
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 5:
+            raise OSError("transient")
+        return "ok"
+
+    got = with_retries(
+        flaky, policy=policy, retryable=lambda e: True,
+        sleep=sleeps.append,
+    )
+    assert got == "ok"
+    assert len(calls) == 5
+    # exponential, capped at max_delay
+    assert sleeps == [0.5, 1.0, 2.0, 3.0]
+
+
+def test_jitter_bounds():
+    from libgrape_lite_tpu.ft.retry import RetryPolicy
+
+    policy = RetryPolicy(base_delay=1.0, multiplier=1.0, jitter=0.25)
+    rng = random.Random(7)
+    for attempt in range(50):
+        d = policy.delay(0, rng)
+        assert 0.75 <= d <= 1.25
+
+
+def test_non_retryable_passes_through_first_attempt():
+    from libgrape_lite_tpu.ft.retry import RetryPolicy, with_retries
+
+    calls = []
+
+    def fail():
+        calls.append(1)
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError, match="permanent"):
+        with_retries(
+            fail,
+            policy=RetryPolicy(max_attempts=5, jitter=0.0),
+            retryable=lambda e: isinstance(e, OSError),
+            sleep=lambda d: None,
+        )
+    assert len(calls) == 1  # no retries burned on an unclassified error
+
+
+def test_exhaustion_raises_original():
+    from libgrape_lite_tpu.ft.retry import (
+        RetryPolicy, RetryableError, with_retries,
+    )
+
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise RetryableError("still down")
+
+    with pytest.raises(RetryableError, match="still down"):
+        with_retries(
+            always,
+            policy=RetryPolicy(max_attempts=3, jitter=0.0),
+            sleep=lambda d: None,
+        )
+    assert len(calls) == 3
+
+
+def test_classifiers():
+    from libgrape_lite_tpu.ft.retry import (
+        is_late_init_error,
+        is_transient_distributed_error,
+        is_transient_io_error,
+    )
+
+    late = RuntimeError(
+        "jax.distributed.initialize() must be called before any JAX "
+        "computations are executed"
+    )
+    assert is_late_init_error(late)
+    assert not is_transient_distributed_error(late)
+
+    # contains "before" but is a timeout — the old substring
+    # classification would have mislabeled this as a late call
+    timeout = RuntimeError("DEADLINE_EXCEEDED: handshake timed out "
+                           "before barrier")
+    assert not is_late_init_error(timeout)
+    assert is_transient_distributed_error(timeout)
+
+    assert is_transient_distributed_error(ConnectionRefusedError("nope"))
+    assert not is_transient_distributed_error(ValueError("bad address"))
+
+    assert not is_transient_io_error(FileNotFoundError("gone"))
+    assert not is_transient_io_error(PermissionError("denied"))
+    import errno
+
+    assert is_transient_io_error(OSError(errno.EIO, "stale NFS handle"))
+    assert not is_transient_io_error(ValueError("not io at all"))
+
+
+def _patch_initialize(monkeypatch, fn):
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize", fn)
+
+
+def test_init_distributed_late_call_classification(monkeypatch):
+    """The late-call contract message only wraps genuine late-call
+    errors (specific phrases + chained cause), never e.g. a timeout
+    whose text happens to contain 'before' (ADVICE r5)."""
+    from libgrape_lite_tpu.ft.retry import RetryPolicy
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+
+    fast = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0)
+
+    def late(**kw):
+        raise RuntimeError(
+            "jax.distributed.initialize() must be called before any JAX "
+            "computations are executed"
+        )
+
+    import jax
+
+    shutdowns = []
+    monkeypatch.setattr(
+        jax.distributed, "shutdown", lambda: shutdowns.append(1)
+    )
+    _patch_initialize(monkeypatch, late)
+    with pytest.raises(RuntimeError, match="init_distributed must run") as ei:
+        CommSpec.init_distributed(
+            coordinator_address="127.0.0.1:1", num_processes=2,
+            process_id=0, retry_policy=fast,
+        )
+    assert isinstance(ei.value.__cause__, RuntimeError)  # chained
+    # a contract violation must NOT tear down a possibly-live runtime
+    assert not shutdowns
+
+    calls = []
+
+    def flaky_timeout(**kw):
+        calls.append(1)
+        raise RuntimeError("UNAVAILABLE: failed to connect before deadline")
+
+    _patch_initialize(monkeypatch, flaky_timeout)
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        CommSpec.init_distributed(
+            coordinator_address="127.0.0.1:1", num_processes=2,
+            process_id=0, retry_policy=fast,
+        )
+    assert len(calls) == 3  # transient -> retried to exhaustion, then
+    # surfaced as itself (NOT rewrapped as a late-call contract error)
+
+
+def test_init_distributed_transient_then_success(monkeypatch):
+    from libgrape_lite_tpu.ft.retry import RetryPolicy
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+
+    calls = []
+
+    def flaky(**kw):
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("DEADLINE_EXCEEDED: coordinator not up")
+
+    _patch_initialize(monkeypatch, flaky)
+    cs = CommSpec.init_distributed(
+        coordinator_address="127.0.0.1:1", num_processes=2, process_id=0,
+        fnum=2,
+        retry_policy=RetryPolicy(max_attempts=4, base_delay=0.0, jitter=0.0),
+    )
+    assert len(calls) == 3
+    assert cs.fnum == 2
+
+
+def test_init_distributed_resets_state_between_attempts(monkeypatch):
+    """jax 0.4.37 sets the global client BEFORE connect(), so without a
+    shutdown between attempts every retry would trip the double-init
+    guard instead of retrying the handshake."""
+    import jax
+
+    from libgrape_lite_tpu.ft.retry import RetryPolicy
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+
+    events = []
+
+    def failing(**kw):
+        events.append("init")
+        raise RuntimeError("UNAVAILABLE: coordinator not up")
+
+    def fake_shutdown():
+        events.append("shutdown")
+
+    _patch_initialize(monkeypatch, failing)
+    monkeypatch.setattr(jax.distributed, "shutdown", fake_shutdown)
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        CommSpec.init_distributed(
+            coordinator_address="127.0.0.1:1", num_processes=2,
+            process_id=0,
+            retry_policy=RetryPolicy(
+                max_attempts=3, base_delay=0.0, jitter=0.0
+            ),
+        )
+    # every failed attempt cleared the half-built distributed state
+    assert events == ["init", "shutdown"] * 3
+
+
+def test_garc_cache_read_retries(monkeypatch, tmp_path):
+    """A transient EIO on the cache shard retries and then succeeds."""
+    import errno
+
+    from libgrape_lite_tpu.fragment import loader as loader_mod
+
+    path = tmp_path / "frag.garc"
+    path.write_bytes(b"payload")
+
+    real_open = open
+    fails = [2]
+
+    def flaky_open(p, mode="r", *a, **kw):
+        if str(p) == str(path) and fails[0] > 0:
+            fails[0] -= 1
+            raise OSError(errno.EIO, "flaky fs")
+        return real_open(p, mode, *a, **kw)
+
+    monkeypatch.setattr("builtins.open", flaky_open)
+    # zero out the backoff so the test doesn't sleep
+    from libgrape_lite_tpu.ft import retry as retry_mod
+
+    monkeypatch.setattr(
+        retry_mod, "CACHE_READ_POLICY",
+        retry_mod.RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0),
+    )
+    assert loader_mod._read_cache_file(str(path)) == b"payload"
+    assert fails[0] == 0

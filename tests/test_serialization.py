@@ -216,3 +216,66 @@ def test_garc_refuses_pickle_stream():
     ar.add_bytes(b"\x80\x04N.")
     with pytest.raises(ValueError, match="pickle"):
         _get_array(OutArchive(ar.get_buffer()))
+
+
+def test_garc_refuses_decompression_bomb():
+    """A deflate stream claiming n elements but inflating far beyond
+    the caller's bound must be rejected at the cap, not materialised
+    (ADVICE r5: decompression-bomb hardening).  Crafted here as an
+    _ENC_VARINT_Z stream whose payload inflates to ~64 MB while the
+    header claims 8 elements (bound: 80 bytes)."""
+    import zlib
+
+    from libgrape_lite_tpu.fragment.loader import (
+        _ENC_VARINT_Z, _bounded_decompress, _get_array,
+    )
+    from libgrape_lite_tpu.utils.archive import InArchive, OutArchive
+
+    bomb = zlib.compress(b"\x01" * (64 << 20), 9)  # ~64 KB compressed
+    ar = InArchive()
+    ar.add_scalar(_ENC_VARINT_Z, "<b")
+    ar.add_scalar(8)          # claimed element count
+    ar.add_scalar(len(bomb))  # payload byte length
+    ar.add_bytes(bomb)
+    with pytest.raises(ValueError, match="corrupt|exceeds"):
+        _get_array(OutArchive(ar.get_buffer()))
+
+    # the helper itself: exact-fit passes, one byte over fails
+    payload = zlib.compress(b"x" * 100)
+    assert _bounded_decompress(payload, 100) == b"x" * 100
+    with pytest.raises(ValueError, match="exceeds"):
+        _bounded_decompress(payload, 99)
+    with pytest.raises(ValueError, match="corrupt"):
+        _bounded_decompress(b"not deflate at all", 100)
+    # max_out=0 must not mean "no limit" (zlib's max_length=0 does): a
+    # stream claiming 0 elements with a non-empty payload is corrupt
+    with pytest.raises(ValueError, match="exceeds"):
+        _bounded_decompress(bomb, 0)
+    assert _bounded_decompress(zlib.compress(b""), 0) == b""
+
+
+def test_garc_compact_env_truthiness(monkeypatch):
+    """GRAPE_GARC_COMPACT="0" and "" must disable compact mode,
+    consistent with GRAPE_LCC_TIERS (ADVICE r5)."""
+    from libgrape_lite_tpu.fragment.loader import (
+        _ENC_DELTA, _ENC_DELTA_Z, _put_array,
+    )
+    from libgrape_lite_tpu.utils.archive import InArchive, OutArchive
+
+    # a long monotone run of small deltas: varint output is highly
+    # compressible, so compact mode always fires when enabled
+    a = np.arange(1 << 14, dtype=np.int64)
+
+    def first_code(env_value):
+        if env_value is None:
+            monkeypatch.delenv("GRAPE_GARC_COMPACT", raising=False)
+        else:
+            monkeypatch.setenv("GRAPE_GARC_COMPACT", env_value)
+        ar = InArchive()
+        _put_array(ar, a)
+        return OutArchive(ar.get_buffer()).get_scalar("<b")
+
+    assert first_code(None) == _ENC_DELTA
+    assert first_code("") == _ENC_DELTA
+    assert first_code("0") == _ENC_DELTA      # the ADVICE r5 bug
+    assert first_code("1") == _ENC_DELTA_Z
