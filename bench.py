@@ -282,6 +282,17 @@ def main():
                 "value": round(ss_mteps, 1),
                 "unit": "MTEPS/chip",
                 "variant": picked,
+                # r6: the dense pull pre-masks the weight stream at
+                # init (one gather pass/round instead of gather +
+                # mask-select); GRAPE_SSSP_FUSE=0 reverts for A/B.
+                # Only the dense-pull variant on the XLA backend HAS
+                # the fused form (sssp_delta never does; the pack
+                # backend bakes weights into the plan instead)
+                "fused_pull": (
+                    picked == "sssp" and ss_winner == "xla"
+                    and os.environ.get(
+                        "GRAPE_SSSP_FUSE", "1") not in ("0", "")
+                ),
                 "vs_baseline":
                     round(ss_mteps / SSSP_BASELINE_MTEPS_PER_CHIP, 3),
             }
@@ -291,6 +302,45 @@ def main():
     else:
         if "sssp" in record:
             print(json.dumps(record), flush=True)
+
+    # static op-budget ledger (r6): the planner's exact per-stage ALU
+    # counts at the bench geometry ride in the BENCH json, and the
+    # cost model's independent recount must agree within 5% — the
+    # op budget is a pinned contract, so a drift fails the bench LOUDLY
+    # (after every measurement is already printed).  First run pays the
+    # O(E log E) planner; the summary is cached under the plan-cache
+    # dir afterwards.  GRAPE_BENCH_NO_LEDGER=1 skips the lane.
+    ledger_mismatch = None
+    if not os.environ.get("GRAPE_BENCH_NO_LEDGER"):
+        try:
+            sys.path.insert(
+                0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "scripts"))
+            from pack_cost_model import (
+                MISMATCH_TOLERANCE,
+                bench_ledger_summary,
+            )
+
+            summ = bench_ledger_summary(SCALE, EDGE_FACTOR,
+                                        cache_dir=PLAN_CACHE_DIR)
+            record["pack_ledger"] = {
+                "alu_ops_per_edge": summ["alu_ops_per_edge"],
+                "gather_slots_per_edge": summ["gather_slots_per_edge"],
+                "bytes_per_edge": summ["bytes_per_edge"],
+                "per_stage_ops_per_edge": summ["per_stage_ops_per_edge"],
+                "modeled": summ["scenarios"],
+                "ledger_recount_mismatch":
+                    summ["ledger_recount_mismatch"],
+            }
+            print(json.dumps(record), flush=True)
+            if summ["ledger_recount_mismatch"] > MISMATCH_TOLERANCE:
+                ledger_mismatch = summ["ledger_recount_mismatch"]
+        except Exception as e:  # the ledger lane must not cost the bench
+            print(
+                f"[bench] pack ledger lane failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
 
     if os.environ.get("GRAPE_BENCH_FULL"):
         # side metrics on stderr AFTER the primary line is out — a hang
@@ -317,6 +367,15 @@ def main():
                 )
             except Exception as e:  # side metrics are best-effort
                 print(f"[bench-extra] {nm}: failed ({e})", file=sys.stderr)
+
+    if ledger_mismatch is not None:
+        print(
+            f"[bench] FATAL: op-budget ledger and cost-model recount "
+            f"disagree by {ledger_mismatch:.1%} (> 5%) — the planner's "
+            "annotations have drifted from the shipped kernels",
+            file=sys.stderr,
+        )
+        sys.exit(2)
 
 
 if __name__ == "__main__":

@@ -22,6 +22,17 @@ import numpy as np
 FINGERPRINT_FORMAT = 1
 
 
+def stable_config_digest(obj: Any) -> str:
+    """sha256 hex of a canonical-JSON rendering of `obj` — the shared
+    config-fingerprint primitive for cache keys (pack plan cache keys
+    its entries by PackConfig + dtype through this).  Non-JSON leaves
+    fall back to str(), so dataclass asdict() payloads with numpy
+    scalars stay stable across processes."""
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True, default=str).encode()
+    ).hexdigest()
+
+
 def app_registry_name(app) -> str:
     """The APP_REGISTRY name for this app instance (first registered
     alias, sorted for determinism), falling back to the class name for

@@ -56,6 +56,15 @@ class SSSP(ParallelAppBase):
         self._pack = None
         state = {"dist": dist}
         eph_entries = {}
+        # fused dense pull (r6): pre-mask the weight stream ONCE at init
+        # (inf at masked edges), so the per-round relax is one gather +
+        # one add — the separate edge_mask select pass is gone and the
+        # result is bit-identical (x + inf == inf == the old masked
+        # lane; distances never reach -inf, so no NaN).  The host CSRs
+        # are already padded to the device Ep, so the stream stacks
+        # uniformly.  GRAPE_SSSP_FUSE=0 reverts for A/B.
+        self._fuse = os.environ.get("GRAPE_SSSP_FUSE", "1") not in (
+            "0", "")
         from libgrape_lite_tpu.parallel.mirror import resolve_mirror_plan
 
         self._mx = resolve_mirror_plan(frag, "ie")
@@ -84,6 +93,15 @@ class SSSP(ParallelAppBase):
                     warn_pack_ineligible("SSSP", "no pack plan buildable")
                 else:
                     eph_entries.update(self._pack.state_entries())
+        if self._pack is not None:
+            self._fuse = False  # pack bakes the weight stream already
+        if self._fuse:
+            eph_entries["wf_eff"] = np.stack([
+                np.where(frag.host_ie[f].edge_mask,
+                         frag.host_ie[f].edge_w,
+                         np.asarray(np.inf, frag.host_ie[f].edge_w.dtype))
+                for f in range(frag.fnum)
+            ])
         if eph_entries:
             state.update(eph_entries)
             self.ephemeral_keys = frozenset(eph_entries)
@@ -108,6 +126,12 @@ class SSSP(ParallelAppBase):
             nbr = ie.edge_nbr
         if self._pack is not None:
             relaxed = self._pack.reduce(full, state, "min")
+        elif self._fuse:
+            # one gather pass: the pre-masked weight stream (wf_eff,
+            # inf at masked edges) folds the relax-mask select into the
+            # add — bit-identical to the where() form
+            cand = full[nbr] + state["wf_eff"]
+            relaxed = self.segment_reduce(cand, ie.edge_src, frag.vp, "min")
         else:
             inf = jnp.asarray(jnp.inf, dist.dtype)
             cand = jnp.where(

@@ -30,6 +30,21 @@ Host API
      must be masked by the caller.  Requires len <= R_src*C and
      <= R_dst*C; elements per src row and per dst row each <= C.
 
+Composition invariant
+---------------------
+Routes COMPOSE at plan time for free: applying Route3 `a` then Route3
+`b` equals the single route planned from the composed slot mapping
+(`compose_routes(a, b)`), because the composed mapping is again a
+partial injection on [R, C] blocks and Koenig's theorem guarantees its
+3-stage factorization exists for ANY such mapping.  Device cost of the
+composed route is 3 moves regardless of how many routes were fused —
+this is what lets the pack planner (ops/spmv_pack.py) land extraction
+outputs directly in the next fold level's sorted layout, collapsing
+the fold-level merge route to a single lane-preserving sublane gather
+(`plan_lane_aligned_rows`).  When a route IS lane-preserving
+(lane(dst) == lane(src) for every element), ship only the [R_dst, C]
+row-index plane and pay 1 move instead of 3.
+
 Kernel API
 ----------
   apply_route3(x, route_arrays...) inside a Pallas kernel, where the
@@ -208,6 +223,57 @@ def plan_route(
     valid[dst_row, dst_slot % c] = True
 
     return Route3(l1=l1, s2=s2, l3=l3[:r_dst], valid=valid[:r_dst])
+
+
+def route_slot_map(rt: Route3, c: int = 128):
+    """Recover the (src_slot, dst_slot) partial injection a Route3
+    realizes: route an iota of flat slot ids and read the valid dst
+    slots.  Entries sourced from internal pad rows never appear (pads
+    only ever feed invalid dst slots)."""
+    r_mid = rt.s2.shape[0]
+    iota = np.arange(r_mid * c, dtype=np.int64).reshape(r_mid, c)
+    routed = apply_route3_np(iota, rt)
+    dst_slot = np.nonzero(rt.valid.reshape(-1))[0]
+    src_slot = routed.reshape(-1)[dst_slot]
+    return src_slot, dst_slot
+
+
+def compose_routes(a: Route3, b: Route3, c: int = 128) -> Route3:
+    """The single Route3 equal to applying `a` then `b`.
+
+    Composition restricts to dst slots of `b` whose source was a VALID
+    dst of `a` (b may route a-holes; those carry garbage under
+    sequential application and are dropped — callers were required to
+    mask them anyway).  r_src is a's middle height (>= its true source
+    height; apply_route3* zero-pads shorter inputs), r_dst is b's."""
+    a_src, a_dst = route_slot_map(a, c)
+    b_src, b_dst = route_slot_map(b, c)
+    # a_dst -> a_src lookup over b's source slots
+    lut = np.full(a.valid.shape[0] * c, -1, dtype=np.int64)
+    lut[a_dst] = a_src
+    b_src_ok = b_src < len(lut)
+    comp_src = np.where(b_src_ok, lut[np.minimum(b_src, len(lut) - 1)],
+                        -1)
+    keep = comp_src >= 0
+    return plan_route(
+        comp_src[keep], b_dst[keep], a.s2.shape[0], b.l3.shape[0], c
+    )
+
+
+def plan_lane_aligned_rows(src_slot: np.ndarray, dst_slot: np.ndarray,
+                           r_dst: int, c: int = 128) -> np.ndarray:
+    """The 1-move form of a LANE-PRESERVING mapping: a [r_dst, c] row
+    index plane for `take_along_axis(x, rows, axis=0)` (a sublane
+    gather — fan-out allowed, unlike a full Route3).  Requires
+    lane(src) == lane(dst) for every element; unrouted dst slots read
+    row 0 (callers mask via their flag/valid planes)."""
+    src_slot = np.asarray(src_slot, dtype=np.int64)
+    dst_slot = np.asarray(dst_slot, dtype=np.int64)
+    if ((src_slot % c) != (dst_slot % c)).any():
+        raise ValueError("mapping is not lane-preserving")
+    rows = np.zeros((r_dst, c), dtype=np.int32)
+    rows[dst_slot // c, dst_slot % c] = src_slot // c
+    return rows
 
 
 def apply_route3_np(x: np.ndarray, rt: Route3) -> np.ndarray:

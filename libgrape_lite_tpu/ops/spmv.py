@@ -42,6 +42,17 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 
+LANE = 128  # vector lane width: tile row spans must lane-align
+
+
+def _align_rmax(span: int) -> int:
+    """Lane-align a tile row span so the kernel's [tile, rmax] matmul
+    output tiles cleanly — the single sizing rule for every path
+    through plan_tiles (the empty-edge case included, which used to
+    hardcode the literal)."""
+    return max(LANE, -(-span // LANE) * LANE)
+
+
 def plan_tiles(edge_src_sorted: np.ndarray, tile: int, vp: int):
     """Host-side strict tiling of a row-sorted edge array (padding rows
     `vp` included — they land in the sliced-off overflow row).
@@ -50,7 +61,11 @@ def plan_tiles(edge_src_sorted: np.ndarray, tile: int, vp: int):
     """
     e = len(edge_src_sorted)
     if e == 0:
-        return np.zeros(1, dtype=np.int32), 128, 1
+        # degenerate shard: one all-pad tile at the minimal aligned
+        # span (derived, not hardcoded — plan_for_app additionally
+        # rejects fully-empty fragments so no indicator matmul runs
+        # for zero real edges)
+        return np.zeros(1, dtype=np.int32), _align_rmax(1), 1
     # span planning must ignore pad edges (src == vp): a boundary tile
     # mixing the last real row with pads would otherwise inflate rmax to
     # ~vp, and the worst span sizes EVERY tile's [tile, rmax] matmul.
@@ -65,9 +80,7 @@ def plan_tiles(edge_src_sorted: np.ndarray, tile: int, vp: int):
     ends = np.minimum(starts + tile, e) - 1
     row_lo = src_plan[starts].astype(np.int32)
     row_hi = src_plan[ends].astype(np.int32)
-    rmax = int((row_hi - row_lo).max()) + 1
-    # lane-align the span so the kernel's matmul output tiles cleanly
-    rmax = max(128, -(-rmax // 128) * 128)
+    rmax = _align_rmax(int((row_hi - row_lo).max()) + 1)
     return row_lo, rmax, num_tiles
 
 
@@ -201,6 +214,11 @@ def plan_for_app(frag, vp: int, dtype, tile: int = 2048,
     if cached is None:
         edge_src_stacked = np.asarray(frag.dev.ie.edge_src)
         fnum = edge_src_stacked.shape[0]
+        if not (edge_src_stacked < vp).any():
+            # zero real edges on every shard: a [tile, rmax] indicator
+            # matmul for nothing — let XLA's trivial segment_sum serve
+            _PLAN_CACHE.setdefault(frag, {})[key] = False
+            return None
         plans = [
             plan_tiles(edge_src_stacked[f], tile, vp) for f in range(fnum)
         ]
@@ -208,6 +226,8 @@ def plan_for_app(frag, vp: int, dtype, tile: int = 2048,
         row_lo = np.stack([p[0] for p in plans]).astype(np.int32)
         cached = (row_lo, tile, rmax)
         _PLAN_CACHE.setdefault(frag, {})[key] = cached
+    if cached is False:  # cached empty-fragment rejection
+        return None
     row_lo, tile, rmax = cached
     if mode != "strict" and not strict_worthwhile(rmax, tile):
         return None

@@ -47,9 +47,40 @@ from typing import List, Optional
 
 import numpy as np
 
-from libgrape_lite_tpu.ops.route3 import Route3, plan_route
+from libgrape_lite_tpu.ops.route3 import (
+    Route3,
+    plan_lane_aligned_rows,
+    plan_route,
+)
 
 C = 128
+
+
+def _compose_enabled() -> bool:
+    """Route composition (upstream extraction lands directly in the
+    downstream fold's sorted layout, collapsing the fold merge route to
+    one sublane move) is on by default; GRAPE_PACK_COMPOSE=0 reverts to
+    the generic 3-stage fold routes for A/B and debugging."""
+    import os
+
+    return os.environ.get("GRAPE_PACK_COMPOSE", "1") not in ("0", "")
+
+
+def _scan_stages_for(rows_sorted: np.ndarray) -> int:
+    """ceil(log2(max segment run)) — the number of shift-combine scan
+    stages that provably reach every segment's start.  After S stages
+    the flag window spans 2^S slots, so any position whose segment
+    start lies within max_seglen-1 <= 2^S - 1 behind it is fully
+    blocked; every further stage combines with the exact identity and
+    is a bit-exact no-op.  Zero stages when every segment has length 1
+    (degree-1 tails; the scan is the identity)."""
+    e = len(rows_sorted)
+    if e == 0:
+        return 0
+    ch = np.nonzero(np.diff(rows_sorted))[0]
+    bounds = np.concatenate([[-1], ch, [e - 1]])
+    max_run = int(np.diff(bounds).max())
+    return max(0, int(np.ceil(np.log2(max(1, max_run)))))
 
 
 def _lane_mix(local: np.ndarray) -> np.ndarray:
@@ -165,8 +196,9 @@ class BlockPlan:
     # gather stage (None on fold levels)
     sub_idx: Optional[np.ndarray]  # [sub, C] int16: x-table row per slot
     hub_sel: Optional[np.ndarray]  # [sub, C] int16: hub idx, -1 if not hub
-    # CSR-restore / merge route (pack slots -> row-sorted slots)
-    route: Route3
+    # CSR-restore / merge route (pack slots -> row-sorted slots); None
+    # when `route_rows` carries the composed lane-preserving form
+    route: Optional[Route3]
     flags: np.ndarray              # [sub, C] int8: bit0 valid, bit1 seg start
     # extraction route (scanned slots -> compact out slots); None on
     # final blocks, which use per-row-range `tiles` instead
@@ -181,6 +213,20 @@ class BlockPlan:
     # at a time (a monolithic [vp//128, 128] extraction blows VMEM at
     # bench vp)
     tiles: Optional[List] = None
+    # span-aware scan: stages the kernel unrolls for this block
+    # (= ceil(log2(max segment run)); further stages are exact no-ops)
+    scan_stages: int = 0
+    # composed merge route: [sub, C] int source-row plane (one sublane
+    # gather) replacing the generic 3-stage `route` on fold levels whose
+    # upstream extractions were rewritten to land lane-aligned
+    route_rows: Optional[np.ndarray] = None
+    # planner-only: scan slots of this block's segment-last elements
+    # (the extraction sources) — consumed when a downstream fold level
+    # composes this block's eroute with its merge permutation
+    e_src: Optional[np.ndarray] = None
+    # static op-budget ledger: exact per-stage vector-ALU op counts
+    # (see _LEDGER_CONVENTIONS in scripts/pack_cost_model.py)
+    ledger: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -193,6 +239,116 @@ class LevelPlan:
     pass_base: int = 0             # x-table offset (gather levels)
     out_sub: int = 0               # output rows per block
     tile_sub: int = 0              # final level: rows per extraction tile
+
+
+def _block_op_ledger(cfg: PackConfig, *, gather: bool, scan_stages: int,
+                     route_moves: int, out_sub: int = 0,
+                     n_tiles: int = 0, tile_sub: int = 0) -> dict:
+    """Exact vector-ALU op counts for one block, by stage.  Counting
+    conventions (shared with scripts/pack_cost_model.py, which verifies
+    them independently from the shipped stream arrays):
+
+      * one op = one full-width vector operation over the operand's
+        [rows, 128] plane, priced `rows * 128` lanes;
+      * gather overlay: the 2 hub select/compare passes (the register
+        -table loop's per-slot cost; the x-table sublane dynamic_gather
+        itself is priced separately as `gather_rows` — its rate is the
+        hardware unknown the probe measures);
+      * route: one op per take_along_axis stage, priced at that
+        stage's operand height (generic Route3: l1/s2 at r_mid, l3 at
+        r_dst; composed lane-aligned form: one sublane gather at sub);
+      * flags: the one segment-flag compare (`flags != 1`);
+      * scan: 3 ops (shift, select, combine) per unrolled stage;
+      * extract: the eroute stages + the out-validity select, or the
+        per-row-range tile routes on final blocks;
+      * fold-input assembly (concat / disjoint-slot merge) runs in XLA
+        outside the kernels and is excluded, as it always was.
+    """
+    slots = cfg.sub * C
+    led = {
+        "overlay": 2 * slots if gather else 0,
+        "route": route_moves * slots,
+        "flags": slots,
+        "scan": 3 * scan_stages * slots,
+    }
+    if n_tiles:
+        led["extract"] = n_tiles * (2 * slots + 2 * tile_sub * C)
+    elif out_sub:
+        r_mid = max(cfg.sub, out_sub)
+        led["extract"] = 2 * r_mid * C + 2 * out_sub * C
+    else:
+        led["extract"] = 0
+    led["gather_rows"] = slots if gather else 0
+    return led
+
+
+def _ledger_of_levels(shard_levels, n_cols: int, cfg: PackConfig) -> dict:
+    """Aggregate the per-block op ledgers of a plan (list over shards
+    of its ordered LevelPlans, final level last) into the static
+    op-budget ledger: exact ALU op / gather-row / HBM-byte counts per
+    level and in total, under the conventions of _block_op_ledger.
+    HBM bytes are the shipped stream tables (post dtype-narrowing, from
+    the real device stacks) plus one x pass-window load per gather
+    level — the same accounting the r4 cost model used."""
+    n_lv = len(shard_levels[0])
+    out_levels = []
+    totals = {"alu_ops": 0, "gather_rows": 0, "hbm_bytes": 0,
+              "blocks": 0}
+    per_stage_tot: dict = {}
+    edges = 0
+    for li in range(n_lv):
+        per_stage: dict = {}
+        gr = 0
+        hbm = 0
+        nbl = 0
+        has_gather = shard_levels[0][li].has_gather
+        for lvs in shard_levels:
+            lv = lvs[li]
+            nbl += len(lv.blocks)
+            for b in lv.blocks:
+                for k, v in b.ledger.items():
+                    if k == "gather_rows":
+                        gr += int(v)
+                    else:
+                        per_stage[k] = per_stage.get(k, 0) + int(v)
+                if lv.has_gather:
+                    edges += int(b.n_edges)
+            if lv.blocks:
+                hbm += sum(
+                    int(n) for n in
+                    _stack_blocks(lv, nbytes_only=True).values()
+                )
+            if lv.has_gather:
+                hbm += min(n_cols, cfg.slots * len(lv.blocks)) * 4
+        alu = sum(per_stage.values())
+        out_levels.append({
+            "level": li, "blocks": nbl, "has_gather": bool(has_gather),
+            "alu_ops": alu, "gather_rows": gr, "hbm_bytes": hbm,
+            "per_stage": per_stage,
+        })
+        totals["alu_ops"] += alu
+        totals["gather_rows"] += gr
+        totals["hbm_bytes"] += hbm
+        totals["blocks"] += nbl
+        for k, v in per_stage.items():
+            per_stage_tot[k] = per_stage_tot.get(k, 0) + v
+    return {
+        "edges": edges,
+        "levels": out_levels,
+        "totals": {**totals, "per_stage": per_stage_tot},
+    }
+
+
+def plan_ledger(plan) -> dict:
+    """The static op-budget ledger of a PackPlan or MultiPackPlan."""
+    if isinstance(plan, MultiPackPlan):
+        if plan.ledger is None:
+            raise ValueError("MultiPackPlan carries no ledger")
+        return plan.ledger
+    levels = list(plan.levels)
+    if plan.final is not None and plan.final.blocks:
+        levels = levels + [plan.final]
+    return _ledger_of_levels([levels], plan.n_cols, plan.cfg)
 
 
 _PLAN_COUNTER = itertools.count()
@@ -333,28 +489,131 @@ def _plan_gather_block(rows, cols, hub_idx, base, cfg: PackConfig,
         w_block = np.zeros((sub, C), dtype=np.float32)
         w_block[csr_r, csr_l] = w.astype(np.float32)
 
+    stages = _scan_stages_for(rows)
     return BlockPlan(
         sub_idx=sub_idx, hub_sel=hub_sel, route=route, flags=flags,
         eroute=eroute, out_rows=out_rows, out_valid=out_valid, n_edges=e,
-        w=w_block,
+        w=w_block, scan_stages=stages, e_src=src,
+        ledger=_block_op_ledger(cfg, gather=True, scan_stages=stages,
+                                route_moves=3, out_sub=cfg.out_sub),
     )
 
 
-def _plan_fold_block(in_rows, in_valid, cfg: PackConfig, out_sub: int,
-                     final_by_row: bool, tile_sub: int = 0):
-    """Plan one fold block: inputs are `in_rows`/`in_valid` for the
-    concatenated slots of its (<= sub*C) input stream; the route sorts
+def _group_prep(grp):
+    """Concatenate a group's stream metadata and compute the merge
+    permutation (valid slots, stably sorted by row — the tie-break that
+    keeps the fold's combine order, and hence every f32 bit,
+    unchanged).  Computed ONCE per group and shared by the feasibility
+    probe and the block planner (the argsort is the planner's unit of
+    work; doubling it doubled cache-cold plan time for nothing)."""
+    in_rows = np.concatenate([r for r, _, _ in grp])
+    in_valid = np.concatenate([v for _, v, _ in grp])
+    val = np.nonzero(in_valid)[0]
+    order = val[np.argsort(in_rows[val], kind="stable")]
+    return in_rows, in_valid, order
+
+
+def _aligned_feasible(grp, cfg: PackConfig, prep=None) -> bool:
+    """True when this group's upstream extractions can be rewritten so
+    the merge route is lane-preserving: per input stream, no merged
+    lane may receive more than out_sub of that stream's elements (each
+    stream is an [out_sub, C] block — out_sub rows of sublane capacity
+    per lane)."""
+    sl = cfg.max_distinct
+    _, _, order = prep if prep is not None else _group_prep(grp)
+    e = len(order)
+    if e == 0:
+        return True
+    lanes = np.arange(e, dtype=np.int64) % C
+    stream_of = order // sl
+    counts = np.bincount(stream_of * C + lanes,
+                         minlength=len(grp) * C)
+    return int(counts.max()) <= cfg.out_sub
+
+
+def _rewrite_upstream_aligned(grp, order, cfg: PackConfig) -> np.ndarray:
+    """Compose each producer's extraction route with this group's merge
+    permutation: producers re-extract straight into lane-aligned
+    compact slots (same lane as the element's final merged slot), so
+    the merge itself collapses to ONE sublane gather.  Mutates the
+    producer BlockPlans (fresh eroute/out_rows/out_valid) and returns
+    the consumer's [sub, C] source-row plane.
+
+    Bit-exactness: `order` (the merge permutation) is computed from the
+    ORIGINAL compact layouts, so every element's final slot — and hence
+    the scan tree and extracted values — is unchanged; only the
+    intermediate compact placement moves."""
+    sl = cfg.max_distinct
+    e = len(order)
+    i = np.arange(e, dtype=np.int64)
+    j_of = order // sl
+    q_old = order % sl
+    lam = i % C
+    # rank within (stream, lane), in final-slot order (i ascending)
+    key = j_of * C + lam
+    ord2 = np.argsort(key, kind="stable")
+    sorted_key = key[ord2]
+    starts = np.searchsorted(sorted_key, sorted_key)
+    ranks = np.empty(e, dtype=np.int64)
+    ranks[ord2] = np.arange(e, dtype=np.int64) - starts
+    q_new = ranks * C + lam
+
+    # the merged route is lane-preserving by construction; the helper
+    # re-checks that invariant and emits the single-move row plane
+    route_rows = plan_lane_aligned_rows(j_of * sl + q_new, i, cfg.sub)
+
+    for j, (r, v, blk) in enumerate(grp):
+        m = j_of == j
+        d_j = int(m.sum())
+        if d_j == 0:
+            continue
+        newq = np.empty(d_j, dtype=np.int64)
+        # the producer's compact slots are the prefix 0..d_j-1, in the
+        # same order as its e_src extraction sources
+        newq[q_old[m]] = q_new[m]
+        assert blk.e_src is not None and len(blk.e_src) == d_j
+        blk.eroute = plan_route(blk.e_src, newq, cfg.sub, cfg.out_sub)
+        nr = np.zeros(sl, dtype=np.int64)
+        nv = np.zeros(sl, dtype=bool)
+        nr[newq] = r[:d_j]
+        nv[newq] = True
+        blk.out_rows = nr
+        blk.out_valid = nv
+    return route_rows
+
+
+def _plan_fold_block(grp, cfg: PackConfig, out_sub: int,
+                     final_by_row: bool, tile_sub: int = 0,
+                     aligned: bool = False, prep=None):
+    """Plan one fold block over a group of input streams
+    [(out_rows, out_valid, producer BlockPlan)]: the merge route sorts
     valid slots by (row, original position), scan folds them, and
     extraction emits one slot per distinct row (or slot==row when
     `final_by_row`, split into `tile_sub`-row range tiles so each
-    extraction kernel program stays within VMEM)."""
+    extraction kernel program stays within VMEM).  With `aligned`, the
+    producers' extractions are rewritten (route composition) and the
+    merge route ships as a single sublane-gather plane instead of a
+    3-stage Route3."""
     sub = cfg.sub
-    n = len(in_rows)
-    assert n <= sub * C
-    val = np.nonzero(in_valid)[0]
-    order = val[np.argsort(in_rows[val], kind="stable")]
+    in_rows, in_valid, order = (
+        prep if prep is not None else _group_prep(grp)
+    )
+    pad = cfg.slots - len(in_rows)
+    assert pad >= 0
+    if pad:
+        # pad slots are invalid and trailing, so `order` (computed on
+        # the unpadded concat) indexes identically into the padded form
+        in_rows = np.concatenate([in_rows, np.zeros(pad, np.int64)])
+        in_valid = np.concatenate([in_valid, np.zeros(pad, bool)])
     e = len(order)
-    route = plan_route(order, np.arange(e, dtype=np.int64), sub, sub)
+    if aligned:
+        route = None
+        route_rows = _rewrite_upstream_aligned(grp, order, cfg)
+        route_moves = 1
+    else:
+        route = plan_route(order, np.arange(e, dtype=np.int64), sub, sub)
+        route_rows = None
+        route_moves = 3
 
     rows_sorted = in_rows[order]
     flags = np.zeros((sub, C), dtype=np.int8)
@@ -362,6 +621,7 @@ def _plan_fold_block(in_rows, in_valid, cfg: PackConfig, out_sub: int,
     seg_start = np.ones(e, dtype=bool)
     seg_start[1:] = rows_sorted[1:] != rows_sorted[:-1]
     flags[csr_r, csr_l] = 1 | (seg_start.astype(np.int8) << 1)
+    stages = _scan_stages_for(rows_sorted)
 
     last = np.ones(e, dtype=bool)
     last[:-1] = rows_sorted[1:] != rows_sorted[:-1]
@@ -375,8 +635,9 @@ def _plan_fold_block(in_rows, in_valid, cfg: PackConfig, out_sub: int,
         out_valid[dst] = True
         # per-row-range extraction tiles (tile_sub rows each)
         tile_sub = tile_sub or out_sub
+        n_tiles = -(-out_sub // tile_sub)
         tiles = []
-        for t in range(-(-out_sub // tile_sub)):
+        for t in range(n_tiles):
             lo = t * tile_sub * C
             hi = lo + tile_sub * C
             m = (dst >= lo) & (dst < hi)
@@ -387,7 +648,11 @@ def _plan_fold_block(in_rows, in_valid, cfg: PackConfig, out_sub: int,
         return BlockPlan(
             sub_idx=None, hub_sel=None, route=route, flags=flags,
             eroute=None, out_rows=out_rows, out_valid=out_valid,
-            n_edges=e, tiles=tiles,
+            n_edges=e, tiles=tiles, scan_stages=stages,
+            route_rows=route_rows,
+            ledger=_block_op_ledger(cfg, gather=False, scan_stages=stages,
+                                    route_moves=route_moves,
+                                    n_tiles=n_tiles, tile_sub=tile_sub),
         )
     assert d <= out_sub * C
     dst = np.arange(d, dtype=np.int64)
@@ -399,6 +664,9 @@ def _plan_fold_block(in_rows, in_valid, cfg: PackConfig, out_sub: int,
     return BlockPlan(
         sub_idx=None, hub_sel=None, route=route, flags=flags,
         eroute=eroute, out_rows=out_rows, out_valid=out_valid, n_edges=e,
+        scan_stages=stages, route_rows=route_rows, e_src=src,
+        ledger=_block_op_ledger(cfg, gather=False, scan_stages=stages,
+                                route_moves=route_moves, out_sub=out_sub),
     )
 
 
@@ -484,7 +752,7 @@ def _level_streams(levels):
     out = []
     for lv in levels:
         for b in lv.blocks:
-            out.append((b.out_rows, b.out_valid))
+            out.append((b.out_rows, b.out_valid, b))
     return out
 
 
@@ -494,12 +762,12 @@ def _plan_mid_folds(streams, cfg: PackConfig):
     group_cap = cfg.sub // cfg.out_sub
     levels = []
     depth = 0
+    compose = _compose_enabled()
     # mid folds: contract while they help (already-compact streams,
     # e.g. degree-1 tails, cannot contract — the multi-block final
     # level absorbs them instead, having no distinct-rows limit)
-    while sum(len(r) for r, _ in streams) > cfg.slots:
-        blocks = []
-        nxt = []
+    while sum(len(r) for r, _, _ in streams) > cfg.slots:
+        grps = []
         i = 0
         while i < len(streams):
             grp = []
@@ -507,29 +775,32 @@ def _plan_mid_folds(streams, cfg: PackConfig):
             distinct = set()
             while (i < len(streams) and len(grp) < group_cap
                    and slots + len(streams[i][0]) <= cfg.slots):
-                r, v = streams[i]
+                r, v, _ = streams[i]
                 u = set(np.unique(r[v]).tolist())
                 if grp and len(distinct | u) > cfg.max_distinct:
                     break
                 distinct |= u
-                grp.append((r, v))
+                grp.append(streams[i])
                 slots += len(r)
                 i += 1
-            in_rows = np.concatenate([r for r, _ in grp])
-            in_valid = np.concatenate([v for _, v in grp])
-            pad = cfg.slots - len(in_rows)
-            if pad:
-                in_rows = np.concatenate(
-                    [in_rows, np.zeros(pad, np.int64)]
-                )
-                in_valid = np.concatenate([in_valid, np.zeros(pad, bool)])
-            blk = _plan_fold_block(in_rows, in_valid, cfg, cfg.out_sub,
-                                   final_by_row=False)
+            grps.append(grp)
+        if len(grps) >= len(streams):
+            break  # no contraction possible; hand over to the final level
+        # route composition engages per level (kernel structure must be
+        # uniform across a level's blocks)
+        preps = [_group_prep(g) for g in grps]
+        aligned = compose and all(
+            _aligned_feasible(g, cfg, p) for g, p in zip(grps, preps)
+        )
+        blocks = []
+        nxt = []
+        for grp, prep in zip(grps, preps):
+            blk = _plan_fold_block(grp, cfg, cfg.out_sub,
+                                   final_by_row=False, aligned=aligned,
+                                   prep=prep)
             blk.n_inputs = len(grp)
             blocks.append(blk)
-            nxt.append((blk.out_rows, blk.out_valid))
-        if len(nxt) >= len(streams):
-            break  # no contraction possible; hand over to the final level
+            nxt.append((blk.out_rows, blk.out_valid, blk))
         levels.append(LevelPlan(cfg=cfg, blocks=blocks, has_gather=False,
                                 out_sub=cfg.out_sub))
         streams = nxt
@@ -538,17 +809,10 @@ def _plan_mid_folds(streams, cfg: PackConfig):
     return levels, streams
 
 
-def _plan_final_level(streams, vp, cfg: PackConfig) -> LevelPlan:
-    """Final level: multi-block, each block scan-folds its streams and
-    extracts straight into the dense [vp] layout (slot == row id) in
-    row-range tiles; block outputs are summed by the caller, so
-    overlapping rows across final blocks are fine.  Grouping is by slot
-    capacity only — data-independent, so multi-shard plans built from
-    uniform stream counts get uniform structure."""
-    vp_sub = vp // C
-    tile_sub = min(vp_sub, _FINAL_TILE_SUB)
-    from concurrent.futures import ThreadPoolExecutor
-
+def _final_groups(streams, cfg: PackConfig):
+    """Capacity-only grouping of the final level's input streams —
+    data-independent, so multi-shard plans built from uniform stream
+    counts get uniform structure."""
     grps = []
     i = 0
     while i < len(streams):
@@ -561,21 +825,40 @@ def _plan_final_level(streams, vp, cfg: PackConfig) -> LevelPlan:
         if not grp:  # single stream larger than a block cannot happen
             raise AssertionError("stream exceeds block capacity")
         grps.append(grp)
+    return grps
 
-    def build(grp):
-        in_rows = np.concatenate([r for r, _ in grp])
-        in_valid = np.concatenate([v for _, v in grp])
-        pad = cfg.slots - len(in_rows)
-        if pad:
-            in_rows = np.concatenate([in_rows, np.zeros(pad, np.int64)])
-            in_valid = np.concatenate([in_valid, np.zeros(pad, bool)])
-        blk = _plan_fold_block(in_rows, in_valid, cfg, vp_sub,
-                               final_by_row=True, tile_sub=tile_sub)
+
+def _plan_final_level(streams, vp, cfg: PackConfig,
+                      aligned: bool | None = None,
+                      preps=None) -> LevelPlan:
+    """Final level: multi-block, each block scan-folds its streams and
+    extracts straight into the dense [vp] layout (slot == row id) in
+    row-range tiles; block outputs are summed by the caller, so
+    overlapping rows across final blocks are fine.  `aligned=None`
+    decides route composition from this stream set alone; multi-shard
+    planning passes the all-shard AND so the skeleton stays uniform."""
+    vp_sub = vp // C
+    tile_sub = min(vp_sub, _FINAL_TILE_SUB)
+    from concurrent.futures import ThreadPoolExecutor
+
+    grps = _final_groups(streams, cfg)
+    if preps is None:
+        preps = [_group_prep(g) for g in grps]
+    if aligned is None:
+        aligned = _compose_enabled() and all(
+            _aligned_feasible(g, cfg, p) for g, p in zip(grps, preps)
+        )
+
+    def build(grp_prep):
+        grp, prep = grp_prep
+        blk = _plan_fold_block(grp, cfg, vp_sub, final_by_row=True,
+                               tile_sub=tile_sub, aligned=aligned,
+                               prep=prep)
         blk.n_inputs = len(grp)
         return blk
 
     with ThreadPoolExecutor() as pool:
-        fblocks = list(pool.map(build, grps))
+        fblocks = list(pool.map(build, list(zip(grps, preps))))
     return LevelPlan(cfg=cfg, blocks=fblocks, has_gather=False,
                      out_sub=vp_sub, tile_sub=tile_sub)
 
@@ -672,20 +955,25 @@ def _jnp_kind(kind):
     }[kind]
 
 
-def _scan_np(v, f, kind):
+def _scan_np(v, f, kind, stages: int | None = None):
     """Segmented inclusive scan over flattened [sub, C] row-major order
-    via shift-combine stages — mirrors the kernel exactly."""
+    via shift-combine stages — mirrors the kernel exactly.  `stages`
+    truncates the unroll (span-aware scans: beyond
+    ceil(log2(max_seglen)) every stage combines with the identity, so
+    truncation is bit-exact); None runs the full log2(n) ladder."""
     op, ident, _ = _KINDS[kind]
     sub = v.shape[0]
     n = sub * C
     vf = v.reshape(n).copy()
     ff = f.reshape(n).copy().astype(bool)
     s = 1
-    while s < n:
+    done = 0
+    while s < n and (stages is None or done < stages):
         carry = np.where(ff[s:], ident, vf[:-s])
         vf[s:] = op(vf[s:], carry)
         ff[s:] = ff[s:] | ff[:-s]
         s *= 2
+        done += 1
     return vf.reshape(sub, C)
 
 
@@ -715,15 +1003,21 @@ def _exec_block_np(plan: PackPlan, lv: LevelPlan, blk: BlockPlan, x,
         vals = np.where(hs >= 0, v_hub, v_tab)
     else:
         vals = in_vals
-    # route to row-sorted order
-    routed = apply_route3_np(vals.astype(np.float64), blk.route)
+    # route to row-sorted order (composed plans ship the fold merge as
+    # a single sublane-gather plane; values at invalid slots are
+    # arbitrary but each is its own flagged segment, so they can never
+    # combine into — or be extracted as — a real row's value)
+    if blk.route_rows is not None:
+        routed = np.take_along_axis(
+            vals.astype(np.float64),
+            blk.route_rows.astype(np.int64), axis=0,
+        )
+    else:
+        routed = apply_route3_np(vals.astype(np.float64), blk.route)
     if lv.has_gather and blk.w is not None:
         routed = wop(routed, blk.w.astype(np.float64))
-    valid = (blk.flags & 1).astype(bool)
-    segst = ((blk.flags >> 1) & 1).astype(np.float64)
-    routed = np.where(valid, routed, ident)
-    f0 = np.where(valid, segst, 1.0)
-    cs = _scan_np(routed, f0, kind)
+    f0 = (blk.flags != 1).astype(np.float64)
+    cs = _scan_np(routed, f0, kind, blk.scan_stages)
     if blk.tiles is not None:
         # final block: per-row-range extraction tiles concatenate into
         # the dense [vp] layout
@@ -793,8 +1087,15 @@ def exec_plan_np(plan: PackPlan, x: np.ndarray, kind="sum") -> np.ndarray:
 
 def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
                  n_stages: int, kind: str = "sum", has_w: bool = False,
-                 extract: bool = True):
-    """Build the kernel function for one level (shapes static)."""
+                 extract: bool = True, aligned: bool = False):
+    """Build the kernel function for one scan group (shapes static).
+
+    `n_stages` is the group's span-aware scan unroll — blocks are
+    batched into pallas_calls by their planned stage count, so a
+    degree-1 tail block runs 0 shift-combine stages while a hub-heavy
+    block runs the full ladder.  `aligned` selects the composed fold
+    path: the merge route arrives as ONE sublane-gather plane (rr)
+    instead of a 3-stage Route3."""
     import jax
     import jax.numpy as jnp
 
@@ -831,22 +1132,30 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
 
     from libgrape_lite_tpu.ops.route3 import apply_route3
 
-    def scan_part(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref):
-        """Shared route -> segmented scan."""
+    def scan_part(vals, w_ref, route_refs, flags_ref):
+        """Shared route -> segmented scan.  Values at invalid slots are
+        left unmasked: every invalid slot is its own flagged segment
+        (flags==0 -> f0=1), so garbage there can neither combine into a
+        real segment nor be extracted — the old per-slot validity
+        select was a no-op on every observable output."""
         flags = flags_ref[0].astype(jnp.int32)
-        routed = apply_route3(vals, l1_ref[0], s2_ref[0], l3_ref[0])
+        if aligned:
+            (rr_ref,) = route_refs
+            routed = jnp.take_along_axis(
+                vals, rr_ref[0].astype(jnp.int32), axis=0
+            )
+        else:
+            l1_ref, s2_ref, l3_ref = route_refs
+            routed = apply_route3(vals, l1_ref[0], s2_ref[0], l3_ref[0])
         if w_ref is not None:
             routed = wop(routed, w_ref[0])
-        valid = (flags & 1) > 0
-        segst = ((flags >> 1) & 1).astype(vals.dtype)
-        routed = jnp.where(valid, routed, jnp.full_like(routed, ident))
-        f0 = jnp.where(valid, segst, jnp.ones_like(segst))
+        f0 = (flags != 1).astype(vals.dtype)
         return scan_segmented(routed, f0)
 
-    def tail(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
+    def tail(vals, w_ref, route_refs, flags_ref,
              el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
         """Shared route -> segmented scan -> extraction epilogue."""
-        cs = scan_part(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref)
+        cs = scan_part(vals, w_ref, route_refs, flags_ref)
         ex = apply_route3(cs, el1_ref[0], es2_ref[0], el3_ref[0])
         out_ref[0] = jnp.where(eval_ref[0] > 0, ex,
                                jnp.full_like(ex, ident))
@@ -872,16 +1181,22 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
                 gk = jnp.take_along_axis(tk, hub_lo, axis=1)
                 v_hub = jnp.where(hub_hi == k, gk, v_hub)
             vals = jnp.where(hs >= 0, v_hub, v_tab)
-            tail(vals, w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
+            tail(vals, w_ref, (l1_ref, s2_ref, l3_ref), flags_ref,
                  el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
 
     if not extract:
         # final-level phase A: fold-scan only; phase B extracts per
         # row-range tile from the scanned plane
-        def kernel(vals_ref, l1_ref, s2_ref, l3_ref, flags_ref,
-                   out_ref):
-            out_ref[0] = scan_part(vals_ref[0], None, l1_ref, s2_ref,
-                                   l3_ref, flags_ref)
+        if aligned:
+            def kernel(vals_ref, rr_ref, flags_ref, out_ref):
+                out_ref[0] = scan_part(vals_ref[0], None, (rr_ref,),
+                                       flags_ref)
+        else:
+            def kernel(vals_ref, l1_ref, s2_ref, l3_ref, flags_ref,
+                       out_ref):
+                out_ref[0] = scan_part(vals_ref[0], None,
+                                       (l1_ref, s2_ref, l3_ref),
+                                       flags_ref)
 
         return kernel
 
@@ -899,10 +1214,15 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
             _gather_kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
                            None, l1_ref, s2_ref, l3_ref, flags_ref,
                            el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
+    elif aligned:
+        def kernel(vals_ref, rr_ref, flags_ref,
+                   el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
+            tail(vals_ref[0], None, (rr_ref,), flags_ref,
+                 el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
     else:
         def kernel(vals_ref, l1_ref, s2_ref, l3_ref, flags_ref,
                    el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
-            tail(vals_ref[0], None, l1_ref, s2_ref, l3_ref, flags_ref,
+            tail(vals_ref[0], None, (l1_ref, s2_ref, l3_ref), flags_ref,
                  el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
 
     return kernel
@@ -926,44 +1246,76 @@ def _extract_kernel_body(kind: str = "sum"):
     return kernel
 
 
-def _stack_blocks(lv: LevelPlan):
-    """Stack a level's static block arrays into device-ready numpy.
+def _stage_order(blocks):
+    """Stable ordering of a level's blocks by scan stage count — the
+    device executor batches same-stage blocks into one pallas_call, so
+    the stacked streams ship in this order (skel.order maps back)."""
+    return np.argsort([b.scan_stages for b in blocks], kind="stable")
+
+
+def _narrowed_dtype(arrs, dtype):
+    """Widen rather than wrap when a stream outgrows its narrow dtype
+    (the final level's es2 rows scale with vp//128, which PackConfig
+    cannot bound)."""
+    if np.issubdtype(dtype, np.integer):
+        info = np.iinfo(dtype)
+        lo = min(int(a.min()) for a in arrs)
+        hi = max(int(a.max()) for a in arrs)
+        if lo < info.min or hi > info.max:
+            return np.dtype(np.int32)
+    return np.dtype(dtype)
+
+
+def _stack_blocks(lv: LevelPlan, nbytes_only: bool = False):
+    """Stack a level's static block arrays into device-ready numpy, in
+    scan-stage-sorted block order (see _stage_order).
 
     Index streams stay narrow on device (lane ids int8, row ids int16 —
     ADVICE r2: int32 streams double the VMEM bill for nothing); the
     kernel upcasts to int32 at each use site.  Lane ids are < 128 and
-    block row ids < 32768 by PackConfig validation; a stream whose
-    values outgrow the narrow dtype (the final level's es2 scales with
-    vp//128) widens to int32 instead of wrapping."""
+    block row ids < 32768 by PackConfig validation; widening is decided
+    by _narrowed_dtype.
+
+    `nbytes_only` returns each stream's exact shipped byte count
+    instead of the arrays — the op-budget ledger prices HBM from the
+    same dtype decisions without paying for a second full copy of
+    hundreds of MB of stream tables."""
     import numpy as np
 
-    def st(get, dtype):
-        out = np.stack([get(b) for b in lv.blocks])
-        if np.issubdtype(dtype, np.integer):
-            # widen rather than wrap when a stream outgrows its narrow
-            # dtype (the final level's es2 rows scale with vp//128,
-            # which PackConfig cannot bound)
-            info = np.iinfo(dtype)
-            if out.min() < info.min or out.max() > info.max:
-                dtype = np.int32
-        return out.astype(dtype)
+    blocks = [lv.blocks[i] for i in _stage_order(lv.blocks)]
 
-    d = {
-        "l1": st(lambda b: b.route.l1, np.int8),
-        "s2": st(lambda b: b.route.s2, np.int16),
-        "l3": st(lambda b: b.route.l3, np.int8),
-        "flags": st(lambda b: b.flags, np.int8),
-    }
+    def st(get, dtype):
+        arrs = [np.asarray(get(b)) for b in blocks]
+        dtype = _narrowed_dtype(arrs, dtype)
+        if nbytes_only:
+            return sum(a.size for a in arrs) * dtype.itemsize
+        return np.stack(arrs).astype(dtype)
+
+    if blocks[0].route_rows is not None:
+        # composed fold level: the merge route is one sublane-gather
+        # row plane — 3x fewer index streams than a generic Route3
+        d = {
+            "rr": st(lambda b: b.route_rows, np.int16),
+            "flags": st(lambda b: b.flags, np.int8),
+        }
+    else:
+        d = {
+            "l1": st(lambda b: b.route.l1, np.int8),
+            "s2": st(lambda b: b.route.s2, np.int16),
+            "l3": st(lambda b: b.route.l3, np.int8),
+            "flags": st(lambda b: b.flags, np.int8),
+        }
     if lv.blocks[0].tiles is not None:
         # final level: per-row-range tile extraction routes
         def tst(get, dtype):
-            out = np.stack([
-                np.stack([get(t) for t in b.tiles]) for b in lv.blocks
-            ])
-            if np.issubdtype(dtype, np.integer):
-                info = np.iinfo(dtype)
-                if out.min() < info.min or out.max() > info.max:
-                    dtype = np.int32
+            arrs = [np.asarray(get(t)) for b in blocks for t in b.tiles]
+            dtype = _narrowed_dtype(arrs, dtype)
+            if nbytes_only:
+                return sum(a.size for a in arrs) * dtype.itemsize
+            nt = len(blocks[0].tiles)
+            out = np.stack(arrs).reshape(
+                (len(blocks), nt) + arrs[0].shape
+            )
             return out.astype(dtype)
 
         d["tel1"] = tst(lambda t: t[0].l1, np.int8)
@@ -991,8 +1343,9 @@ def _stack_blocks(lv: LevelPlan):
 class LevelSkel:
     """The static structure of one level — everything the executor
     needs besides the stream arrays themselves.  Under shard_map every
-    shard runs the SAME skeleton (plan_pack_multi pads shards to make
-    that true); the streams arrive as per-shard inputs."""
+    shard runs the SAME skeleton (plan_pack_multi pads shards and
+    unifies per-block scan stages to make that true); the streams
+    arrive as per-shard inputs."""
 
     has_gather: bool
     is_final: bool
@@ -1002,9 +1355,25 @@ class LevelSkel:
     pass_idx: int           # gather: index into the x pass stack
     has_w: bool
     n_inputs: tuple         # per block: input streams consumed
+    # span-aware scan batching: ((stages, nblocks), ...) over the
+    # stage-sorted block order the streams ship in, and the map from
+    # sorted position back to original block index
+    scan_groups: tuple = ()
+    order: tuple = ()
+    # composed fold level: merge route ships as one sublane-gather
+    # plane ("rr") instead of a 3-stage Route3
+    aligned: bool = False
 
 
 def _skel_of(lv: LevelPlan, span: int) -> LevelSkel:
+    order = tuple(int(i) for i in _stage_order(lv.blocks))
+    groups: list[list[int]] = []
+    for pos in order:
+        s = int(lv.blocks[pos].scan_stages)
+        if groups and groups[-1][0] == s:
+            groups[-1][1] += 1
+        else:
+            groups.append([s, 1])
     return LevelSkel(
         has_gather=lv.has_gather,
         is_final=lv.blocks[0].tiles is not None if lv.blocks else False,
@@ -1014,6 +1383,10 @@ def _skel_of(lv: LevelPlan, span: int) -> LevelSkel:
         pass_idx=lv.pass_base // span if lv.has_gather else 0,
         has_w=lv.has_gather and lv.blocks[0].w is not None,
         n_inputs=tuple(b.n_inputs for b in lv.blocks),
+        scan_groups=tuple((s, c) for s, c in groups),
+        order=order,
+        aligned=bool(lv.blocks
+                     and lv.blocks[0].route_rows is not None),
     )
 
 
@@ -1030,7 +1403,13 @@ def _level_device(plan: PackPlan, key, lv: LevelPlan):
 def _run_level_dev(cfg: PackConfig, skel: LevelSkel, dev, x_tab, hub_tab,
                    in_streams, interpret: bool, kind: str = "sum"):
     """Run one level's pallas_call(s) from its skeleton + stream dict;
-    returns list of per-block flat output streams (traced jnp arrays).
+    returns list of per-block flat output streams (traced jnp arrays)
+    in ORIGINAL block order (downstream consumption order and the
+    final summation order are bit-load-bearing).
+
+    Blocks are batched by their span-aware scan stage count — one
+    pallas_call per (stages, count) group over the stage-sorted stream
+    stacks, so each group unrolls exactly the stages its segments need.
     Final levels run two phases: a fold-scan over each block, then a
     (block, row-tile) extraction grid whose VMEM residency is bounded
     by tile_sub regardless of vp."""
@@ -1040,15 +1419,18 @@ def _run_level_dev(cfg: PackConfig, skel: LevelSkel, dev, x_tab, hub_tab,
 
     nb = skel.nb
     sub, out_sub = cfg.sub, skel.out_sub
-    n_stages = max(1, int(np.ceil(np.log2(sub * C))))
     has_w = skel.has_gather and skel.has_w
+    max_stages = max(1, int(np.ceil(np.log2(sub * C))))
+    groups = skel.scan_groups or ((max_stages, nb),)
+    order = skel.order or tuple(range(nb))
 
     def bspec(shape_sub):
         return pl.BlockSpec((1, shape_sub, C), lambda i: (i, 0, 0))
 
-    def fold_inputs():
-        # assemble the ragged fold inputs into a uniform [nb, sub, C]
-        # (all offsets static; these are plain XLA concats/reshapes)
+    def fold_input_list():
+        # assemble the ragged fold inputs into per-block [sub, C]
+        # planes, original block order (all offsets static; these are
+        # plain XLA concats/reshapes)
         parts = []
         off = 0
         for k in skel.n_inputs:
@@ -1062,26 +1444,47 @@ def _run_level_dev(cfg: PackConfig, skel: LevelSkel, dev, x_tab, hub_tab,
                 ]
             parts.append(jnp.concatenate(segs).reshape(sub, C))
             off += k
-        return jnp.stack(parts)
+        return parts
 
-    rmid = dev["s2"].shape[-2]
-    route_in = [dev["l1"], dev["s2"], dev["l3"], dev["flags"]]
-    route_specs = [bspec(rmid), bspec(rmid), bspec(sub), bspec(sub)]
+    if skel.aligned:
+        route_in = [dev["rr"], dev["flags"]]
+        route_specs = [bspec(sub), bspec(sub)]
+    else:
+        rmid = dev["s2"].shape[-2]
+        route_in = [dev["l1"], dev["s2"], dev["l3"], dev["flags"]]
+        route_specs = [bspec(rmid), bspec(rmid), bspec(sub), bspec(sub)]
+
+    def unsort(outs_sorted):
+        outs = [None] * nb
+        for spos, o in enumerate(outs_sorted):
+            outs[order[spos]] = o
+        return outs
 
     if skel.is_final:
         # ---- phase A: fold-scan each block to its scanned plane ----
-        scan_kernel = _kernel_body(False, sub, sub, cfg.hub, n_stages,
-                                   kind, False, extract=False)
-        cs = pl.pallas_call(
-            scan_kernel,
-            grid=(nb,),
-            in_specs=[bspec(sub)] + route_specs,
-            out_specs=bspec(sub),
-            out_shape=jax.ShapeDtypeStruct((nb, sub, C), jnp.float32),
-            interpret=interpret,
-        )(fold_inputs(), *route_in)
+        parts = fold_input_list()
+        parts_sorted = [parts[i] for i in order]
+        cs_sorted = []
+        off = 0
+        for stages, cnt in groups:
+            scan_kernel = _kernel_body(False, sub, sub, cfg.hub, stages,
+                                       kind, False, extract=False,
+                                       aligned=skel.aligned)
+            cs = pl.pallas_call(
+                scan_kernel,
+                grid=(cnt,),
+                in_specs=[bspec(sub)] + route_specs,
+                out_specs=bspec(sub),
+                out_shape=jax.ShapeDtypeStruct((cnt, sub, C),
+                                               jnp.float32),
+                interpret=interpret,
+            )(jnp.stack(parts_sorted[off:off + cnt]),
+              *[a[off:off + cnt] for a in route_in])
+            cs_sorted.extend(cs[b] for b in range(cnt))
+            off += cnt
 
-        # ---- phase B: extract row-range tiles ----
+        # ---- phase B: extract row-range tiles (tile streams are
+        # stacked in the same stage-sorted order) ----
         nt = dev["tel1"].shape[1]
         tile_sub = skel.tile_sub
         ermid = dev["tes2"].shape[-2]
@@ -1105,11 +1508,10 @@ def _run_level_dev(cfg: PackConfig, skel: LevelSkel, dev, x_tab, hub_tab,
                 (nb, nt, tile_sub, C), jnp.float32
             ),
             interpret=interpret,
-        )(cs, dev["tel1"], dev["tes2"], dev["tel3"], dev["teval"])
-        return [out[b].reshape(-1) for b in range(nb)]
+        )(jnp.stack(cs_sorted), dev["tel1"], dev["tes2"], dev["tel3"],
+          dev["teval"])
+        return unsort([out[b].reshape(-1) for b in range(nb)])
 
-    kernel = _kernel_body(skel.has_gather, sub, out_sub, cfg.hub,
-                          n_stages, kind, has_w)
     ermid = dev["es2"].shape[-2]
     common_in = route_in + [
         dev["el1"], dev["es2"], dev["el3"], dev["eval"],
@@ -1119,30 +1521,51 @@ def _run_level_dev(cfg: PackConfig, skel: LevelSkel, dev, x_tab, hub_tab,
     ]
 
     if skel.has_gather:
-        args = [x_tab, hub_tab, dev["sub_idx"], dev["hub_sel"]]
-        specs = [
+        stacked = [dev["sub_idx"], dev["hub_sel"]]
+        stacked_specs = [bspec(sub), bspec(sub)]
+        if has_w:
+            stacked.append(dev["w"])
+            stacked_specs.append(bspec(sub))
+        stacked += common_in
+        stacked_specs += common_specs
+        invariant = [x_tab, hub_tab]
+        inv_specs = [
             pl.BlockSpec((sub, C), lambda i: (0, 0)),
             pl.BlockSpec((cfg.hub // C, C), lambda i: (0, 0)),
-            bspec(sub), bspec(sub),
         ]
-        if has_w:
-            args.append(dev["w"])
-            specs.append(bspec(sub))
-        args += common_in
-        specs += common_specs
+        parts_sorted = None
     else:
-        args = [fold_inputs()] + common_in
-        specs = [bspec(sub)] + common_specs
+        stacked = common_in
+        stacked_specs = common_specs
+        invariant = []
+        inv_specs = []
+        parts = fold_input_list()
+        parts_sorted = [parts[i] for i in order]
 
-    out = pl.pallas_call(
-        kernel,
-        grid=(nb,),
-        in_specs=specs,
-        out_specs=bspec(out_sub),
-        out_shape=jax.ShapeDtypeStruct((nb, out_sub, C), jnp.float32),
-        interpret=interpret,
-    )(*args)
-    return [out[b].reshape(-1) for b in range(nb)]
+    outs_sorted = []
+    off = 0
+    for stages, cnt in groups:
+        kernel = _kernel_body(skel.has_gather, sub, out_sub, cfg.hub,
+                              stages, kind, has_w, aligned=skel.aligned)
+        args = list(invariant)
+        specs = list(inv_specs)
+        if parts_sorted is not None:
+            args.append(jnp.stack(parts_sorted[off:off + cnt]))
+            specs.append(bspec(sub))
+        args += [a[off:off + cnt] for a in stacked]
+        specs += stacked_specs
+        out = pl.pallas_call(
+            kernel,
+            grid=(cnt,),
+            in_specs=specs,
+            out_specs=bspec(out_sub),
+            out_shape=jax.ShapeDtypeStruct((cnt, out_sub, C),
+                                           jnp.float32),
+            interpret=interpret,
+        )(*args)
+        outs_sorted.extend(out[b].reshape(-1) for b in range(cnt))
+        off += cnt
+    return unsort(outs_sorted)
 
 
 def _exec_levels(x, cfg: PackConfig, vp: int, n_cols: int, level_list,
@@ -1250,6 +1673,8 @@ class MultiPackPlan:
     skels: List[LevelSkel]               # ordered; final level last
     host_streams: dict                   # name -> [fnum, ...] numpy
     uid: int = field(default_factory=lambda: next(_PLAN_COUNTER))
+    # static op-budget ledger (summed across shards; see plan_ledger)
+    ledger: Optional[dict] = None
 
     def state_entries(self, prefix: str) -> dict:
         """Numpy state entries ([fnum, ...] leaves) to merge into the
@@ -1306,11 +1731,44 @@ def plan_pack_multi(shards, vp: int, n_cols: int,
                                                      has_w))
             levels_per_shard[f].append(lv)
 
+    # route composition must produce ONE skeleton: engage the aligned
+    # final level only when every shard's stream set is feasible.
+    # Group preps (the per-group merge argsort) are computed once per
+    # shard and shared with the final-level planner below.
+    per_shard_streams = [
+        _level_streams(levels_per_shard[f]) for f in range(fnum)
+    ]
+    per_shard_groups = [
+        _final_groups(s, cfg) for s in per_shard_streams
+    ]
+    per_shard_preps = [
+        [_group_prep(g) for g in grps] for grps in per_shard_groups
+    ]
+    aligned_final = _compose_enabled() and all(
+        _aligned_feasible(g, cfg, p)
+        for grps, preps in zip(per_shard_groups, per_shard_preps)
+        for g, p in zip(grps, preps)
+    )
     all_levels: list[list[LevelPlan]] = []
     for f in range(fnum):
-        streams = _level_streams(levels_per_shard[f])
-        final = _plan_final_level(streams, vp, cfg)
+        final = _plan_final_level(per_shard_streams[f], vp, cfg,
+                                  aligned=aligned_final,
+                                  preps=per_shard_preps[f])
         all_levels.append(levels_per_shard[f] + [final])
+    # span-aware scans unroll a static stage count; under shard_map all
+    # shards run one traced program, so unify each block's stages to
+    # the per-block max across shards (extra stages are bit-exact
+    # no-ops for the shard that needed fewer)
+    for li in range(len(all_levels[0])):
+        for bj in range(len(all_levels[0][li].blocks)):
+            s = max(all_levels[f][li].blocks[bj].scan_stages
+                    for f in range(fnum))
+            for f in range(fnum):
+                blk = all_levels[f][li].blocks[bj]
+                if blk.scan_stages != s:
+                    blk.scan_stages = s
+                    blk.ledger = {**blk.ledger,
+                                  "scan": 3 * s * cfg.slots}
 
     if not pass_idxs:
         # zero edges on every shard
@@ -1341,6 +1799,7 @@ def plan_pack_multi(shards, vp: int, n_cols: int,
     return MultiPackPlan(
         vp=vp, n_cols=n_cols, cfg=cfg, fnum=fnum, skels=skels,
         host_streams=host_streams,
+        ledger=_ledger_of_levels(all_levels, n_cols, cfg),
     )
 
 
@@ -1488,7 +1947,7 @@ def pack_plan_to_multi(plan: PackPlan) -> MultiPackPlan:
     streams["hub_cols"] = plan.hub_cols[None]
     return MultiPackPlan(
         vp=plan.vp, n_cols=plan.n_cols, cfg=plan.cfg, fnum=1,
-        skels=skels, host_streams=streams,
+        skels=skels, host_streams=streams, ledger=plan_ledger(plan),
     )
 
 
@@ -1513,6 +1972,12 @@ class PackDispatch:
     @property
     def uid(self) -> int:
         return self.mplan.uid
+
+    def ledger(self) -> Optional[dict]:
+        """The plan's static op-budget ledger (None for plans loaded
+        from a pre-ledger cache entry — impossible under the current
+        schema, kept for safety)."""
+        return self.mplan.ledger
 
     def state_entries(self) -> dict:
         """Ephemeral state leaves ([fnum, ...] numpy) the app must merge
@@ -1607,17 +2072,36 @@ def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
 # exact edge streams + geometry + schema version, stored as one .npz of
 # the stacked stream tables under $GRAPE_PACK_PLAN_CACHE.
 
-_PLAN_SCHEMA_VERSION = 1
+_PLAN_SCHEMA_VERSION = 2
 
 
 def _shards_digest(shards, vp: int, n_cols: int, cfg: PackConfig) -> str:
+    """Content key for cached plans.  The config prefix fingerprints
+    the FULL PackConfig (every dataclass field, so a future knob can't
+    silently alias two configs), the stream dtypes, the schema version
+    and the planner modes — a config or dtype change therefore
+    invalidates stale cached plans instead of loading a mismatched
+    one."""
+    import dataclasses
     import hashlib
 
+    from libgrape_lite_tpu.ft.fingerprint import stable_config_digest
+
+    cfg_fp = stable_config_digest({
+        "schema": _PLAN_SCHEMA_VERSION,
+        "cfg": dataclasses.asdict(cfg),
+        "final_tile_sub": _FINAL_TILE_SUB,
+        "compose": _compose_enabled(),
+        "vp": vp,
+        "n_cols": n_cols,
+        "dtypes": [
+            [str(np.asarray(r).dtype), str(np.asarray(c).dtype),
+             None if w is None else str(np.asarray(w).dtype)]
+            for r, c, w in shards
+        ],
+    })
     h = hashlib.sha256()
-    h.update(
-        f"v{_PLAN_SCHEMA_VERSION}|{vp}|{n_cols}|{cfg.sub}|{cfg.out_sub}"
-        f"|{cfg.hub}|{_FINAL_TILE_SUB}".encode()
-    )
+    h.update(cfg_fp.encode())
     for rows, cols, w in shards:
         h.update(np.ascontiguousarray(rows, np.int64).tobytes())
         h.update(np.ascontiguousarray(cols, np.int64).tobytes())
@@ -1653,6 +2137,7 @@ def _save_cached_mplan(mplan: MultiPackPlan, shards):
         "fnum": mplan.fnum,
         "cfg": [mplan.cfg.sub, mplan.cfg.out_sub, mplan.cfg.hub],
         "skels": [dataclasses.asdict(s) for s in mplan.skels],
+        "ledger": mplan.ledger,
     }
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -1679,13 +2164,21 @@ def _load_cached_mplan(shards, vp, n_cols, cfg):
         if (meta["vp"], meta["n_cols"]) != (vp, n_cols):
             return None
         skels = [
-            LevelSkel(**{**d, "n_inputs": tuple(d["n_inputs"])})
+            LevelSkel(**{
+                **d,
+                "n_inputs": tuple(d["n_inputs"]),
+                "scan_groups": tuple(
+                    (int(s), int(c)) for s, c in d.get("scan_groups", ())
+                ),
+                "order": tuple(int(i) for i in d.get("order", ())),
+            })
             for d in meta["skels"]
         ]
         streams = {k: z[k] for k in z.files if k != "__meta"}
         return MultiPackPlan(
             vp=vp, n_cols=n_cols, cfg=cfg, fnum=meta["fnum"],
             skels=skels, host_streams=streams,
+            ledger=meta.get("ledger"),
         )
     except Exception:
         return None  # corrupt/stale cache entries are rebuilt

@@ -388,6 +388,24 @@ class Worker:
                 )
 
         state = self._place_state(state_np)
+        led = self.pack_ledger()
+        if led:
+            # per-stage ALU attribution for the engaged pack plan — the
+            # stepwise profile's wall-clock lines read against these
+            # modeled shares (first-light playbook step 3)
+            t = led["totals"]
+            e = max(1, led["edges"])
+            stages = ", ".join(
+                f"{k}={v / e:.1f}"
+                for k, v in sorted(t.get("per_stage", {}).items())
+            )
+            glog.vlog(
+                1,
+                f"pack op-budget: {t['alu_ops'] / e:.1f} ALU ops/edge, "
+                f"{t['gather_rows'] / e:.2f} gather rows/edge over "
+                f"{t['blocks']} blocks / {len(led['levels'])} levels "
+                f"(per-stage ops/edge: {stages})",
+            )
         inc_fn = self._compile_single_step("inceval", state)
         # ephemeral leaves drop out of each step's outputs; re-merge the
         # placed originals so the next step's inputs stay complete
@@ -492,6 +510,47 @@ class Worker:
         self._terminate_code = min(0, int(active))
         self._result_state = state
         return state
+
+    def pack_ledger(self):
+        """The engaged pack backend's static op-budget ledger
+        (spmv_pack.plan_ledger form), or None when no pack dispatch is
+        resolved on the app — the stepwise profiling hook and external
+        harnesses read per-stage ALU attribution from here.  Apps that
+        resolve SEVERAL dispatches (WCC pulls both directions) get the
+        SUM of their ledgers: the per-round bill is every engaged
+        plan's ops, and attributing only one would mislead the
+        measured-vs-modeled comparison."""
+        ledgers = []
+        for attr in ("_pack", "_pack_ie", "_pack_oe"):
+            d = getattr(self.app, attr, None)
+            if d is not None and callable(getattr(d, "ledger", None)):
+                led = d.ledger()
+                if led:
+                    ledgers.append(led)
+        if not ledgers:
+            return None
+        if len(ledgers) == 1:
+            return ledgers[0]
+        totals = {"alu_ops": 0, "gather_rows": 0, "hbm_bytes": 0,
+                  "blocks": 0, "per_stage": {}}
+        out = {"edges": 0, "levels": [], "totals": totals}
+        for di, led in enumerate(ledgers):
+            out["edges"] += led["edges"]
+            # re-index so merged level keys stay unique across plans
+            # (a reader attributing wall clock per level must not see
+            # two colliding "level 0" rows)
+            out["levels"] += [
+                {**lv, "level": len(out["levels"]) + i,
+                 "dispatch": di}
+                for i, lv in enumerate(led["levels"])
+            ]
+            for k in ("alu_ops", "gather_rows", "hbm_bytes", "blocks"):
+                totals[k] += led["totals"][k]
+            for k, v in led["totals"].get("per_stage", {}).items():
+                totals["per_stage"][k] = (
+                    totals["per_stage"].get(k, 0) + v
+                )
+        return out
 
     def resume(self, checkpoint_dir: str, max_rounds: int | None = None, *,
                checkpoint_every: int | None = None, fault_plan=None):

@@ -66,4 +66,12 @@ w = Worker(app, frag)
 w.query_stepwise(max_rounds=10)   # logs per-round wall clock
 EOF
 
+echo "== op-budget ledger vs measurement (offline-safe; the stepwise
+profile above logs the same per-stage attribution via the worker's
+pack op-budget vlog line) =="
+timeout 1800 python scripts/pack_cost_model.py \
+  2> "$OUT/cost_model.err" | tee "$OUT/cost_model.json" || {
+  echo "LEDGER/COST-MODEL MISMATCH (see $OUT/cost_model.err)" >&2
+}
+
 echo "== done; results in $OUT =="

@@ -1,0 +1,198 @@
+"""Static op-budget regression for the pack-gather SpMV (QUICK lane).
+
+Planner-only — no jax, no kernels, no hardware: builds small real
+plans and pins the ALU diet so a future refactor can't silently
+regress it.  Three contracts:
+
+  1. the planner's per-block ledger annotations must agree with an
+     independent recount from the SHIPPED stream arrays (the same
+     cross-check `scripts/pack_cost_model.py` and bench.py enforce at
+     bench geometry with a 5% tolerance — here, exactly);
+  2. ops/edge at a fixed power-law geometry stays under the pinned
+     budget (measured 48.1 at pin time; the bench-geometry number the
+     acceptance gate tracks is <= 90 from 150 pre-diet);
+  3. span-aware scan truncation is bit-exact against the full ladder
+     for every planned max_seglen, including seglen == 1 and the
+     power-of-two boundaries.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from libgrape_lite_tpu.ops.spmv_pack import (  # noqa: E402
+    PackConfig,
+    _scan_np,
+    _scan_stages_for,
+    _shards_digest,
+    exec_plan_np,
+    plan_ledger,
+    plan_pack,
+)
+
+CFG = PackConfig(sub=64, out_sub=16, hub=128)
+
+# measured 48.06 ops/edge at this geometry when the budget was pinned
+# (r6 ALU diet: span-aware scans + composed routes + flag narrowing);
+# small headroom for numpy/ordering jitter, none for a real regression
+OPS_PER_EDGE_PIN = 50.0
+
+
+def _powerlaw_graph(seed=5, vp=4096, e=60000):
+    rng = np.random.default_rng(seed)
+    rows = np.minimum((rng.pareto(1.1, e) * 9).astype(np.int64), vp - 1)
+    cols = np.minimum((rng.pareto(1.2, e) * 5).astype(np.int64), vp - 1)
+    order = np.argsort(rows, kind="stable")
+    return rows[order], cols[order], vp
+
+
+def test_ledger_matches_independent_recount_exactly():
+    """The per-block annotations and a from-the-arrays recount must
+    agree to the op — any drift means the ledger no longer describes
+    the kernels that actually run."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from pack_cost_model import independent_op_estimate
+
+    rows, cols, vp = _powerlaw_graph()
+    plan = plan_pack(rows, cols, vp, vp, CFG)
+    led = plan_ledger(plan)
+    rec = independent_op_estimate(plan)
+    assert led["totals"]["alu_ops"] == rec["alu_ops"]
+    assert led["totals"]["gather_rows"] == rec["gather_rows"]
+
+
+def test_ops_per_edge_budget_pinned():
+    rows, cols, vp = _powerlaw_graph()
+    plan = plan_pack(rows, cols, vp, vp, CFG)
+    led = plan_ledger(plan)
+    per_edge = led["totals"]["alu_ops"] / led["edges"]
+    assert per_edge <= OPS_PER_EDGE_PIN, (
+        f"pack ALU budget regressed: {per_edge:.1f} ops/edge > pinned "
+        f"{OPS_PER_EDGE_PIN} — a planner/kernel change re-fattened the "
+        "pipeline; re-run scripts/pack_cost_model.py and re-justify"
+    )
+    # the ledger must carry every stage the kernels run
+    assert set(led["totals"]["per_stage"]) == {
+        "overlay", "route", "flags", "scan", "extract"
+    }
+
+
+def test_scan_stages_span_aware():
+    """Degree-1 tails plan 0 scan stages; a single hot row needs the
+    full in-block ladder; stages never exceed log2(slots)."""
+    assert _scan_stages_for(np.zeros(0, np.int64)) == 0
+    assert _scan_stages_for(np.arange(100)) == 0          # all runs == 1
+    assert _scan_stages_for(np.zeros(1, np.int64)) == 0
+    assert _scan_stages_for(np.zeros(2, np.int64)) == 1
+    assert _scan_stages_for(np.zeros(256, np.int64)) == 8
+    assert _scan_stages_for(np.zeros(257, np.int64)) == 9
+
+    vp = 2048
+    rows = np.arange(vp, dtype=np.int64)  # degree-1 tail
+    rng = np.random.default_rng(3)
+    plan = plan_pack(rows, rng.integers(0, vp, vp), vp, vp, CFG)
+    for lv in plan.levels:
+        if lv.has_gather:
+            assert all(b.scan_stages == 0 for b in lv.blocks)
+
+    hot = np.zeros(6000, dtype=np.int64)  # one row, e edges
+    plan_hot = plan_pack(hot, rng.integers(0, 256, 6000), 256, 256, CFG)
+    slots = CFG.sub * 128
+    top = max(b.scan_stages for lv in plan_hot.levels
+              for b in lv.blocks)
+    assert top == math.ceil(math.log2(min(6000, slots)))
+    for lv in list(plan_hot.levels) + [plan_hot.final]:
+        for b in lv.blocks:
+            assert 0 <= b.scan_stages <= math.ceil(math.log2(slots))
+
+
+@pytest.mark.parametrize("seglen", [1, 2, 3, 4, 7, 8, 9, 127, 128, 129,
+                                    255, 256])
+@pytest.mark.parametrize("kind", ["sum", "min"])
+def test_truncated_scan_bit_exact(seglen, kind):
+    """For segments of max length L, ceil(log2(L)) stages produce the
+    SAME array, bit for bit, as the full ladder — the extra stages
+    combine with the exact identity."""
+    rng = np.random.default_rng(seglen)
+    sub = 8
+    n = sub * 128
+    rows = np.arange(n) // seglen          # equal-length segments
+    v = rng.normal(size=n)
+    f = np.ones(n)
+    f[1:] = (rows[1:] != rows[:-1]).astype(float)
+    stages = max(0, math.ceil(math.log2(seglen)))
+    full = _scan_np(v.reshape(sub, 128), f.reshape(sub, 128), kind)
+    trunc = _scan_np(v.reshape(sub, 128), f.reshape(sub, 128), kind,
+                     stages)
+    np.testing.assert_array_equal(full, trunc)
+    if stages > 0:  # one stage short must differ somewhere (sanity)
+        short = _scan_np(v.reshape(sub, 128), f.reshape(sub, 128),
+                         kind, stages - 1)
+        if seglen > 1:
+            assert not np.array_equal(full, short)
+
+
+def test_compose_off_parity_bitwise():
+    """GRAPE_PACK_COMPOSE=0 (generic 3-stage fold routes) and the
+    composed default must produce bit-identical outputs — composition
+    moves only the intermediate compact layout, never the merge order
+    or the scan tree."""
+    rows, cols, vp = _powerlaw_graph(seed=11, vp=2048, e=30000)
+    x = np.random.default_rng(0).normal(size=vp)
+    old = os.environ.get("GRAPE_PACK_COMPOSE")
+    try:
+        os.environ["GRAPE_PACK_COMPOSE"] = "1"
+        plan_c = plan_pack(rows, cols, vp, vp, CFG)
+        os.environ["GRAPE_PACK_COMPOSE"] = "0"
+        plan_g = plan_pack(rows, cols, vp, vp, CFG)
+    finally:
+        if old is None:
+            os.environ.pop("GRAPE_PACK_COMPOSE", None)
+        else:
+            os.environ["GRAPE_PACK_COMPOSE"] = old
+    # composition engaged on the composed plan, not on the generic one
+    fold_lvls = [lv for lv in plan_c.levels if not lv.has_gather]
+    assert plan_c.final.blocks[0].route_rows is not None or any(
+        lv.blocks[0].route_rows is not None for lv in fold_lvls
+    ), "composition never engaged at this geometry"
+    assert plan_g.final.blocks[0].route_rows is None
+    for kind in ("sum", "min"):
+        np.testing.assert_array_equal(
+            exec_plan_np(plan_c, x, kind), exec_plan_np(plan_g, x, kind)
+        )
+    # and the composed plan spends strictly fewer modeled route ops
+    led_c = plan_ledger(plan_c)["totals"]["per_stage"]["route"]
+    led_g = plan_ledger(plan_g)["totals"]["per_stage"]["route"]
+    assert led_c < led_g
+
+
+def test_digest_invalidates_on_config_and_dtype():
+    """GRAPE_PACK_PLAN_CACHE keys carry a full PackConfig + dtype
+    fingerprint: a config or dtype change must produce a different
+    digest (a stale cached plan can never be loaded for it)."""
+    rng = np.random.default_rng(7)
+    rows = np.sort(rng.integers(0, 512, 1000))
+    cols = rng.integers(0, 512, 1000)
+    w32 = rng.uniform(0.1, 1.0, 1000).astype(np.float32)
+    base = _shards_digest([(rows, cols, None)], 512, 512, CFG)
+    assert _shards_digest(
+        [(rows, cols, None)], 512, 512,
+        PackConfig(sub=64, out_sub=16, hub=256),
+    ) != base
+    assert _shards_digest(
+        [(rows, cols, None)], 512, 512,
+        PackConfig(sub=32, out_sub=16, hub=128),
+    ) != base
+    assert _shards_digest([(rows, cols, w32)], 512, 512, CFG) != base
+    assert _shards_digest(
+        [(rows, cols, w32.astype(np.float64))], 512, 512, CFG
+    ) != _shards_digest([(rows, cols, w32)], 512, 512, CFG)
+    # stable across calls (it keys an on-disk cache)
+    assert _shards_digest([(rows, cols, None)], 512, 512, CFG) == base

@@ -16,7 +16,10 @@ pytestmark = pytest.mark.slow
 
 from libgrape_lite_tpu.ops.route3 import (
     apply_route3_np,
+    compose_routes,
+    plan_lane_aligned_rows,
     plan_route,
+    route_slot_map,
 )
 
 C = 128
@@ -114,6 +117,95 @@ def test_overfull_row_rejected():
     # the router does not support (it routes partial injections)
     with pytest.raises(ValueError):
         plan_route(np.zeros(C + 1, np.int64), np.arange(C + 1), 2, 2)
+
+
+# --------------------------------------------------------------------------
+# composition: applying route a then b == the single composed route
+# --------------------------------------------------------------------------
+
+
+def test_slot_map_roundtrip():
+    rng = np.random.default_rng(17)
+    n = 16 * C
+    src = rng.choice(n, size=n // 2, replace=False)
+    dst = rng.choice(n, size=n // 2, replace=False)
+    rt = plan_route(src, dst, 16, 16)
+    m_src, m_dst = route_slot_map(rt)
+    got = dict(zip(m_dst.tolist(), m_src.tolist()))
+    want = dict(zip(dst.tolist(), src.tolist()))
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_composed_equals_sequential_full_permutations(seed):
+    rng = np.random.default_rng(100 + seed)
+    r = 16
+    n = r * C
+    p1 = rng.permutation(n)
+    p2 = rng.permutation(n)
+    a = plan_route(np.arange(n), p1, r, r)
+    b = plan_route(np.arange(n), p2, r, r)
+    comp = compose_routes(a, b)
+    x = rng.normal(size=(r, C)).astype(np.float32)
+    seq = apply_route3_np(apply_route3_np(x, a), b)
+    got = apply_route3_np(x, comp)
+    assert comp.valid.all()
+    np.testing.assert_array_equal(got, seq)
+
+
+@pytest.mark.parametrize("seed", [5, 6, 7])
+def test_composed_equals_sequential_partial_rectangular(seed):
+    """Partial injections through rectangular blocks (the shape of an
+    extraction followed by a fold merge): composition restricts to b's
+    destinations whose source was a valid destination of a — exactly
+    the elements sequential application routes deterministically."""
+    rng = np.random.default_rng(200 + seed)
+    ra, rb, rc = 32, 8, 24
+    ka = rb * C // 2
+    src_a = rng.choice(ra * C, size=ka, replace=False)
+    dst_a = rng.choice(rb * C, size=ka, replace=False)
+    a = plan_route(src_a, dst_a, ra, rb)
+    kb = rb * C // 3
+    src_b = rng.choice(rb * C, size=kb, replace=False)
+    dst_b = rng.choice(rc * C, size=kb, replace=False)
+    b = plan_route(src_b, dst_b, rb, rc)
+    comp = compose_routes(a, b)
+
+    x = rng.normal(size=(ra, C)).astype(np.float64)
+    mid = np.where(a.valid, apply_route3_np(x, a), np.nan)
+    seq = apply_route3_np(mid, b)
+    got = apply_route3_np(x, comp)
+    # composed validity = b-destinations fed from a-valid slots
+    a_valid_flat = a.valid.reshape(-1)
+    exp_valid = np.zeros(rc * C, bool)
+    for s, d in zip(src_b, dst_b):
+        if s < len(a_valid_flat) and a_valid_flat[s]:
+            exp_valid[d] = True
+    np.testing.assert_array_equal(comp.valid.reshape(-1), exp_valid)
+    np.testing.assert_array_equal(
+        got[comp.valid], seq[comp.valid]
+    )
+    assert not np.isnan(got[comp.valid]).any()
+
+
+def test_lane_aligned_rows_single_move():
+    """A lane-preserving mapping routes with ONE sublane gather; fan-out
+    (several destinations reading one source) is allowed, which a full
+    Route3 cannot express."""
+    rng = np.random.default_rng(31)
+    r_src, r_dst = 8, 16
+    dst = np.arange(r_dst * C)
+    src_rows = rng.integers(0, r_src, r_dst * C)
+    src = src_rows * C + dst % C          # same lane, arbitrary row
+    rows = plan_lane_aligned_rows(src, dst, r_dst)
+    x = rng.normal(size=(r_src, C)).astype(np.float32)
+    got = np.take_along_axis(
+        np.concatenate([x, np.zeros((r_dst - r_src, C), x.dtype)]),
+        rows.astype(np.int64), axis=0,
+    )
+    np.testing.assert_array_equal(got.reshape(-1), x.reshape(-1)[src])
+    with pytest.raises(ValueError):
+        plan_lane_aligned_rows(np.array([1]), np.array([2]), 4)
 
 
 def test_dtype_preserved_and_holes_zeroed():
