@@ -152,6 +152,22 @@ class AppBase:
     def finalize(self, frag, state: Dict):
         raise NotImplementedError
 
+    # ---- runtime invariants (guard/) ----
+    #
+    # Named device-side predicates over consecutive carries, evaluated
+    # by the guard monitor when GRAPE_GUARD (or Worker.query(guard=...))
+    # arms it: every round in stepwise execution, at every chunk
+    # boundary in the guarded-fused path.  The default is the generic
+    # floor (NaN-free float carries); apps override to declare their
+    # algebraic invariants (monotone distances, conserved mass, label
+    # ranges).  `state` is the example carry (placed leaves) — use it
+    # to inspect dtypes/keys; predicates themselves are traced.
+
+    def invariants(self, frag, state: Dict) -> list:
+        from libgrape_lite_tpu.guard.invariants import default_invariants
+
+        return default_invariants(self, frag, state)
+
     # ---- MutationContext (reference grape/app/mutation_context.h) ----
     #
     # Apps that mutate the graph mid-query define `collect_mutations`;

@@ -60,6 +60,13 @@ def make_parser() -> argparse.ArgumentParser:
                         "--checkpoint_dir (query args replay from the "
                         "checkpoint metadata; the config fingerprint "
                         "must match)")
+    p.add_argument("--guard", default="",
+                   choices=["", "off", "warn", "halt", "rollback"],
+                   help="runtime invariant guard policy (guard/): warn "
+                        "logs breaches, halt raises with a diagnostic "
+                        "bundle, rollback self-heals from the last "
+                        "checkpoint (needs --checkpoint_every); default "
+                        "reads GRAPE_GUARD")
     p.add_argument("--profile", action="store_true",
                    help="stepwise rounds with per-round timing (PROFILING)")
     p.add_argument("--platform", default="",

@@ -78,6 +78,35 @@ def _cache_dir(efile: str, vfile: str, spec: LoadGraphSpec, fnum: int) -> str:
     return os.path.join(spec.serialization_prefix, h, f"part_{fnum}"), sig
 
 
+VALIDATE_LOAD_ENV = "GRAPE_VALIDATE_LOAD"
+
+
+def _validate_load(frag: ShardedEdgecutFragment) -> ShardedEdgecutFragment:
+    """GRAPE_VALIDATE_LOAD=1 gate: structural validation of every host
+    CSR right after a load/deserialize (graph/csr.py `CSR.validate`).
+    A malformed or tampered input — especially a hand-assembled or
+    bit-rotted serialization cache — fails loudly HERE instead of
+    producing wrong results three queries later."""
+    if os.environ.get(VALIDATE_LOAD_ENV, "") in ("", "0"):
+        return frag
+    n_pad = frag.fnum * frag.vp
+    aliased = frag.host_ie is frag.host_oe
+    sides = [("oe", frag.host_oe)] if aliased else [
+        ("oe", frag.host_oe), ("ie", frag.host_ie)
+    ]
+    for side, csrs in sides:
+        for f, c in enumerate(csrs):
+            c.validate(name=f"{side}[{f}]", n_pad=n_pad)
+    from libgrape_lite_tpu.utils import logging as glog
+
+    glog.vlog(
+        1,
+        f"load validation: {len(sides) * frag.fnum} CSR(s) structurally "
+        "sound",
+    )
+    return frag
+
+
 def LoadGraph(
     efile: str,
     vfile: str | None,
@@ -92,7 +121,7 @@ def LoadGraph(
         cache, sig = _cache_dir(efile, vfile or "", spec, comm_spec.fnum)
 
     if spec.deserialize and cache and os.path.exists(os.path.join(cache, "sig")):
-        return _deserialize_fragment(cache, comm_spec, spec)
+        return _validate_load(_deserialize_fragment(cache, comm_spec, spec))
 
     src, dst, w = read_edge_file(
         efile, weighted=spec.weighted, string_id=spec.string_id
@@ -132,7 +161,7 @@ def LoadGraph(
 
     if spec.serialize and cache:
         _serialize_fragment(frag, cache, sig)
-    return frag
+    return _validate_load(frag)
 
 
 # ---- archive-backed cache format (utils/archive.py) ---------------------

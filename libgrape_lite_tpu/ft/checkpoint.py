@@ -302,7 +302,9 @@ class CheckpointManager:
             fh.flush()
             os.fsync(fh.fileno())
         if os.path.exists(final):  # re-checkpoint of the same round
-            shutil.rmtree(final)
+            # (a guard rollback-replay re-saves restored rounds);
+            # ignore_errors: a concurrent cleaner may have won the race
+            shutil.rmtree(final, ignore_errors=True)
         os.rename(tmp, final)
         self._gc()
         glog.vlog(
@@ -312,6 +314,18 @@ class CheckpointManager:
         )
 
     def _gc(self) -> None:
-        steps = list_checkpoints(self.directory)
+        """Retention sweep: keep the newest `keep` complete
+        checkpoints.  Tolerant of concurrent removal — another process
+        (an external cleaner, a second resume, a shared-dir race) may
+        delete entries or the directory itself between the listing and
+        the rmtree; retention must never take down a healthy run, so
+        every step of the sweep swallows FileNotFoundError/OSError and
+        moves on."""
+        try:
+            steps = list_checkpoints(self.directory)
+        except OSError as e:  # pragma: no cover - listdir race
+            glog.vlog(1, f"checkpoint gc: listing failed ({e}); skipping")
+            return
         for _, path in steps[: max(0, len(steps) - self.keep)]:
+            # ignore_errors: the entry may already be gone
             shutil.rmtree(path, ignore_errors=True)

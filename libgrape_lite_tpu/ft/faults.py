@@ -6,17 +6,25 @@ child process without code changes) or constructed directly in tests.
 
 Spec grammar — comma-separated tokens:
 
-    kill@K        kill the process after superstep K's checkpoint is
-                  durable (os._exit; `mode=raise` raises InjectedFault
-                  instead, for in-process tests)
-    corrupt@K     flip bytes in the newest checkpoint shard after the
-                  superstep-K checkpoint lands (exercises the
-                  corrupt-shard fallback on resume)
-    capacity=N    clamp the planned all_to_all message capacity to N,
-                  forcing the overflow vote + capacity-retry ladder
-                  (message_manager.plan_initial_capacity)
-    mode=raise    kill via InjectedFault instead of os._exit
-    exit=N        exit code for the kill (default 17)
+    kill@K            kill the process after superstep K's checkpoint
+                      is durable (os._exit; `mode=raise` raises
+                      InjectedFault instead, for in-process tests)
+    corrupt@K         flip bytes in the newest checkpoint shard after
+                      the superstep-K checkpoint lands (exercises the
+                      corrupt-shard fallback on resume)
+    corrupt_carry@K   overwrite a slice of the live device carry right
+                      after superstep K (once, stepwise path): NaN
+                      into the primary float leaf, a negative sentinel
+                      into an int leaf — the guard/ self-heal drill's
+                      device-state fault
+    capacity=N        clamp the planned all_to_all message capacity to
+                      N, forcing the overflow vote + capacity-retry
+                      ladder (message_manager.plan_initial_capacity)
+    mode=raise        kill via InjectedFault instead of os._exit
+    exit=N            exit code for the kill (default 17)
+
+An unknown or malformed token raises `FaultSpecError` naming the
+grammar — a typo like `kil@3` must never parse to a silent no-op plan.
 
 Example drill: `GRAPE_FT_FAULTS=kill@4` then resume from the same
 checkpoint dir — the resumed run must be byte-identical to an
@@ -39,6 +47,25 @@ class InjectedFault(RuntimeError):
     """A deliberately injected fault (mode=raise kills)."""
 
 
+SPEC_GRAMMAR = (
+    "kill@K, corrupt@K, corrupt_carry@K, capacity=N, mode=raise|exit, "
+    "exit=N"
+)
+
+
+class FaultSpecError(ValueError):
+    """A GRAPE_FT_FAULTS spec token is unknown or malformed.  Typed so
+    drills can distinguish a bad spec from a genuinely injected fault;
+    the message always lists the supported grammar."""
+
+    def __init__(self, token: str, why: str):
+        super().__init__(
+            f"bad fault token {token!r} in {FAULTS_ENV}: {why} "
+            f"(supported spec forms: {SPEC_GRAMMAR})"
+        )
+        self.token = token
+
+
 def corrupt_file(path: str, nbytes: int = 16, offset: Optional[int] = None):
     """Flip `nbytes` bytes mid-file — a truncation-free corruption that
     only a content checksum can catch."""
@@ -59,33 +86,52 @@ def corrupt_file(path: str, nbytes: int = 16, offset: Optional[int] = None):
 class FaultPlan:
     kill_at_superstep: Optional[int] = None
     corrupt_checkpoint_at: Optional[int] = None
+    corrupt_carry_at: Optional[int] = None
     capacity_clamp: Optional[int] = None
     mode: str = "exit"  # exit | raise
     exit_code: int = DEFAULT_KILL_EXIT_CODE
+    _carry_fired: bool = False  # corrupt_carry injects once per process
+
+    @staticmethod
+    def _int_of(tok: str, payload: str) -> int:
+        try:
+            return int(payload)
+        except ValueError:
+            raise FaultSpecError(
+                tok, f"{payload!r} is not an integer"
+            ) from None
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":
         plan = cls()
         for tok in filter(None, (t.strip() for t in spec.split(","))):
-            if tok.startswith("kill@"):
-                plan.kill_at_superstep = int(tok[len("kill@"):])
+            # longest prefixes first: corrupt@ must not swallow
+            # corrupt_carry@
+            if tok.startswith("corrupt_carry@"):
+                plan.corrupt_carry_at = cls._int_of(
+                    tok, tok[len("corrupt_carry@"):]
+                )
+            elif tok.startswith("kill@"):
+                plan.kill_at_superstep = cls._int_of(tok, tok[len("kill@"):])
             elif tok.startswith("corrupt@"):
-                plan.corrupt_checkpoint_at = int(tok[len("corrupt@"):])
+                plan.corrupt_checkpoint_at = cls._int_of(
+                    tok, tok[len("corrupt@"):]
+                )
             elif tok.startswith("capacity="):
-                plan.capacity_clamp = max(1, int(tok[len("capacity="):]))
+                plan.capacity_clamp = max(
+                    1, cls._int_of(tok, tok[len("capacity="):])
+                )
             elif tok.startswith("mode="):
                 mode = tok[len("mode="):]
                 if mode not in ("exit", "raise"):
-                    raise ValueError(f"unknown fault kill mode {mode!r}")
+                    raise FaultSpecError(
+                        tok, f"unknown kill mode {mode!r}"
+                    )
                 plan.mode = mode
             elif tok.startswith("exit="):
-                plan.exit_code = int(tok[len("exit="):])
+                plan.exit_code = cls._int_of(tok, tok[len("exit="):])
             else:
-                raise ValueError(
-                    f"unknown fault token {tok!r} in {FAULTS_ENV} "
-                    "(grammar: kill@K, corrupt@K, capacity=N, "
-                    "mode=raise, exit=N)"
-                )
+                raise FaultSpecError(tok, "unknown fault kind")
         return plan
 
     @classmethod
@@ -96,6 +142,7 @@ class FaultPlan:
         return (
             self.kill_at_superstep is None
             and self.corrupt_checkpoint_at is None
+            and self.corrupt_carry_at is None
             and self.capacity_clamp is None
         )
 
@@ -113,6 +160,56 @@ class FaultPlan:
                 f"{cap} -> {clamped}"
             )
         return clamped
+
+    def maybe_corrupt_carry(self, carry, rounds: int):
+        """corrupt_carry@K hook (stepwise worker, after superstep
+        `rounds` and its checkpoint save): returns `{key: corrupted
+        ndarray}` for the worker to re-place on device, or None.  Fires
+        once — a guard rollback-replay passes the same superstep again
+        and must then run clean, so the drill can prove byte-identical
+        recovery.  The corruption is a band of poisoned values in the
+        primary per-vertex leaf: NaN for float carries, a negative
+        sentinel for int carries — both are invariant-visible for every
+        model app (guard/invariants.py)."""
+        if (
+            self.corrupt_carry_at is None
+            or rounds != self.corrupt_carry_at
+            or self._carry_fired
+        ):
+            return None
+        import numpy as np
+
+        # deterministic target: the first float per-vertex leaf, else
+        # the first int one (sorted keys)
+        key = None
+        for want_float in (True, False):
+            for k in sorted(carry):
+                a = carry[k]
+                if getattr(a, "ndim", 0) < 2:
+                    continue
+                kind = np.dtype(a.dtype).kind
+                if (kind == "f") == want_float and kind in "fi":
+                    key = k
+                    break
+            if key is not None:
+                break
+        if key is None:
+            glog.log_info(
+                "fault injection: corrupt_carry found no per-vertex "
+                "leaf to poison; skipping"
+            )
+            return None
+        self._carry_fired = True
+        a = np.array(np.asarray(carry[key]))
+        flat = a.reshape(a.shape[0], -1)
+        n = min(16, flat.shape[1])
+        poison = np.nan if np.dtype(a.dtype).kind == "f" else -7
+        flat[0, :n] = poison
+        glog.log_info(
+            f"fault injection: corrupted carry leaf {key!r} after "
+            f"superstep {rounds} ({n} values set to {poison!r})"
+        )
+        return {key: a}
 
     def on_superstep(self, rounds: int, manager=None) -> None:
         """Called by the stepwise worker after superstep `rounds` (and

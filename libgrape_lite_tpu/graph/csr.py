@@ -25,6 +25,11 @@ from dataclasses import dataclass
 import numpy as np
 
 
+class CSRValidationError(ValueError):
+    """The CSR violates its structural contract (see CSR.validate):
+    computing on it would produce wrong results, not a crash."""
+
+
 @dataclass
 class CSR:
     """Host-side (numpy) padded CSR for one fragment."""
@@ -40,6 +45,86 @@ class CSR:
     @property
     def degree(self) -> np.ndarray:
         return np.diff(self.indptr)
+
+    def validate(self, name: str = "csr", n_pad: int | None = None) -> None:
+        """Check every structural invariant of the padding contract and
+        raise `CSRValidationError` naming the first violation.  Wired
+        into the loader behind GRAPE_VALIDATE_LOAD=1 — a malformed or
+        tampered input (especially a deserialized cache) must fail
+        loudly here instead of silently computing garbage.
+
+        `n_pad` bounds neighbor ids (fnum * vp) when the caller knows
+        the global padded id space."""
+
+        def bad(why: str):
+            raise CSRValidationError(f"{name}: {why}")
+
+        ip, src, nbr, mask = (
+            np.asarray(self.indptr), np.asarray(self.edge_src),
+            np.asarray(self.edge_nbr), np.asarray(self.edge_mask),
+        )
+        ep = len(src)
+        ne = self.num_edges
+        if ip.shape != (self.num_rows + 1,):
+            bad(
+                f"indptr shape {ip.shape} != (num_rows + 1,) = "
+                f"({self.num_rows + 1},)"
+            )
+        if len(nbr) != ep or len(mask) != ep:
+            bad(
+                f"edge stream lengths disagree: src={ep} nbr={len(nbr)} "
+                f"mask={len(mask)}"
+            )
+        if self.edge_w is not None and len(self.edge_w) != ep:
+            bad(f"weight stream length {len(self.edge_w)} != {ep}")
+        if not (0 <= ne <= ep):
+            bad(f"num_edges={ne} outside [0, {ep}]")
+        if ip.size and ip[0] != 0:
+            bad(f"indptr[0] = {ip[0]} != 0")
+        if np.any(np.diff(ip) < 0):
+            r = int(np.argmax(np.diff(ip) < 0))
+            bad(f"indptr is not monotone non-decreasing (row {r})")
+        if ip.size and ip[-1] != ne:
+            bad(
+                f"degree/edge-count disagreement: indptr[-1] = "
+                f"{int(ip[-1])} != num_edges = {ne}"
+            )
+        real_src = src[:ne]
+        if ne and (real_src.min() < 0 or real_src.max() >= self.num_rows):
+            bad(
+                f"edge_src out of range: [{real_src.min()}, "
+                f"{real_src.max()}] not within [0, {self.num_rows})"
+            )
+        if np.any(np.diff(real_src) < 0):
+            bad("edge_src is not sorted (adjacency must be (src, nbr) "
+                "ordered)")
+        # per-row extents must agree with the expanded src stream
+        counts = np.bincount(real_src, minlength=self.num_rows) if ne \
+            else np.zeros(self.num_rows, dtype=np.int64)
+        if not np.array_equal(counts, np.diff(ip)):
+            r = int(np.argmax(counts != np.diff(ip)))
+            bad(
+                f"row {r}: indptr degree {int(np.diff(ip)[r])} != "
+                f"edge_src count {int(counts[r])}"
+            )
+        if np.any(src[ne:] != self.num_rows):
+            bad(f"padded edge_src must equal num_rows ({self.num_rows})")
+        if not mask[:ne].all():
+            bad("edge_mask False on a real edge")
+        if mask[ne:].any():
+            bad("edge_mask True on a padded edge")
+        real_nbr = nbr[:ne]
+        if ne and real_nbr.min() < 0:
+            bad(f"negative neighbor id {int(real_nbr.min())}")
+        if ne and n_pad is not None and real_nbr.max() >= n_pad:
+            bad(
+                f"neighbor id {int(real_nbr.max())} outside the global "
+                f"padded id space [0, {n_pad})"
+            )
+        if self.edge_w is not None and ne:
+            w = np.asarray(self.edge_w[:ne])
+            if np.isnan(w).any():
+                bad(f"{int(np.isnan(w).sum())} NaN edge weight(s)")
 
 
 def build_csr(

@@ -1,0 +1,72 @@
+"""guard/ — runtime invariant monitors, divergence watchdog, and
+self-healing rollback-replay.
+
+The PIE model gives every query a per-superstep consistent cut; the
+`ft/` subsystem already exploits it for checkpoint/restore.  `guard/`
+closes the loop by *detecting* that a run has gone wrong and driving
+recovery without an operator:
+
+* **Invariants** (`invariants.py`) — app-declared, named device-side
+  predicates over consecutive carries (`AppBase.invariants`):
+  SSSP/BFS distances monotonically non-increasing, PageRank mass
+  conserved within eps, WCC labels non-increasing, all float carries
+  NaN-free, active votes within `[0, vnum]`.
+* **Divergence watchdog** (`watchdog.py`) — a carry-digest history
+  proves oscillation cycles (a digest repeat under a deterministic
+  superstep IS an infinite cycle) and flags K-round residual
+  stagnation, halting with a structured diagnostic bundle instead of
+  spinning to `max_rounds`.
+* **Monitor + breach policies** (`monitor.py`) — `warn | halt |
+  rollback`; rollback restores the last good snapshot via
+  `ft.checkpoint.restore_latest`, replays in stepwise "paranoid" mode
+  (probe every round) to localize the faulty round, and continues.
+
+Execution contract: guards are OFF by default and the fused
+`shard_map(while_loop)` fast path is byte-identical with guards off
+(`Worker.query` consults only the env/kwarg to pick a path; the fused
+runner trace never changes).  Guards on: `query_stepwise` probes every
+round (`GRAPE_GUARD_EVERY` thins the cadence); the fused path runs in
+chunks of `GRAPE_GUARD_EVERY` supersteps with a probe at every chunk
+boundary, so a breach is detected within one cadence.
+"""
+
+from libgrape_lite_tpu.guard.config import (
+    GUARD_ENV,
+    GUARD_EVERY_ENV,
+    GUARD_STAGNATION_ENV,
+    GuardConfig,
+)
+from libgrape_lite_tpu.guard.invariants import (
+    Invariant,
+    default_invariants,
+    finite,
+    in_range,
+    monotone_non_increasing,
+    no_nan,
+)
+from libgrape_lite_tpu.guard.monitor import (
+    DivergenceError,
+    GuardError,
+    GuardMonitor,
+    InvariantBreachError,
+)
+from libgrape_lite_tpu.guard.watchdog import DivergenceWatchdog, carry_digest
+
+__all__ = [
+    "GUARD_ENV",
+    "GUARD_EVERY_ENV",
+    "GUARD_STAGNATION_ENV",
+    "GuardConfig",
+    "Invariant",
+    "default_invariants",
+    "finite",
+    "in_range",
+    "monotone_non_increasing",
+    "no_nan",
+    "GuardError",
+    "InvariantBreachError",
+    "DivergenceError",
+    "GuardMonitor",
+    "DivergenceWatchdog",
+    "carry_digest",
+]

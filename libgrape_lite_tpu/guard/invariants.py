@@ -1,0 +1,120 @@
+"""App-declared runtime invariants.
+
+An `Invariant` is a named device-side predicate over consecutive
+carries: `fn(dev_frag, prev_carry, cur_carry) -> (ok, measure)` where
+`ok` is a scalar bool and `measure` a scalar f32 the diagnostic bundle
+records (typically the violating-element count or the error
+magnitude).  Predicates are traced into ONE jitted probe per query
+(guard/monitor.py), so each evaluation is a single device dispatch.
+
+`requires` names the carry keys the predicate reads; the monitor drops
+invariants whose keys are absent from the actual carry (a subclass
+with different state must not inherit a probe that would KeyError
+mid-trace).
+
+Soundness notes baked into the builders:
+
+* comparisons are NaN-rejecting where it matters — `in_range(lo=0)`
+  catches NaN (NaN >= 0 is False) while `monotone_non_increasing`
+  alone would NOT (NaN > x is also False); pair them.
+* padded rows must satisfy every invariant in a healthy run (pad dist
+  = +inf, pad labels = INT32_MAX, pad rank = 0), so predicates scan
+  the whole carry unmasked — corruption in a padded row is still
+  corruption.
+* CDLP labels are NOT monotone (mode adoption can raise a label);
+  CDLP declares range-membership instead — see models/cdlp.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Invariant:
+    name: str
+    fn: Callable  # (dev_frag, prev, cur) -> (ok scalar, measure scalar)
+    requires: Tuple[str, ...]
+    description: str = field(default="")
+
+    def check(self, dev, prev, cur):
+        ok, measure = self.fn(dev, prev, cur)
+        return jnp.asarray(ok, jnp.bool_), jnp.asarray(measure, jnp.float32)
+
+
+def _count_invariant(name, key, bad_fn, description):
+    def fn(dev, prev, cur):
+        nbad = bad_fn(prev, cur).sum().astype(jnp.int32)
+        return nbad == 0, nbad.astype(jnp.float32)
+
+    return Invariant(name, fn, (key,), description)
+
+
+def no_nan(key: str) -> Invariant:
+    """No NaN anywhere in a float leaf (the generic float-carry guard:
+    +/-inf may be a legitimate sentinel, NaN never is)."""
+    return _count_invariant(
+        f"no_nan({key})", key,
+        lambda prev, cur: jnp.isnan(cur[key]),
+        f"float carry {key!r} must be NaN-free",
+    )
+
+
+def finite(key: str) -> Invariant:
+    """Strictly finite float leaf (no NaN, no +/-inf)."""
+    return _count_invariant(
+        f"finite({key})", key,
+        lambda prev, cur: ~jnp.isfinite(cur[key]),
+        f"float carry {key!r} must be finite",
+    )
+
+
+def in_range(key: str, lo=None, hi=None) -> Invariant:
+    """Every element within [lo, hi] (either bound optional).  NaN
+    fails any given bound, so this doubles as a NaN check."""
+
+    def bad(prev, cur):
+        v = cur[key]
+        ok = jnp.ones(v.shape, bool)
+        if lo is not None:
+            ok = jnp.logical_and(ok, v >= jnp.asarray(lo, v.dtype))
+        if hi is not None:
+            ok = jnp.logical_and(ok, v <= jnp.asarray(hi, v.dtype))
+        return ~ok
+
+    bounds = f"[{'-inf' if lo is None else lo}, {'inf' if hi is None else hi}]"
+    return _count_invariant(
+        f"in_range({key})", key, bad,
+        f"carry {key!r} must lie in {bounds}",
+    )
+
+
+def monotone_non_increasing(key: str) -> Invariant:
+    """No element may grow between consecutive probes (min-propagation
+    carries: SSSP/BFS distances, WCC labels).  Holds across a probe
+    cadence > 1 too — monotonicity is transitive.  NaN-blind by itself
+    (NaN > x is False); pair with `in_range`/`no_nan`."""
+    return _count_invariant(
+        f"monotone_non_increasing({key})", key,
+        lambda prev, cur: cur[key] > prev[key],
+        f"carry {key!r} may only decrease between supersteps",
+    )
+
+
+def default_invariants(app, frag, state) -> list:
+    """The floor every app gets for free: NaN-free float carries.
+    (The active-vote range check `0 <= active <= vnum` is host-side
+    and lives in the monitor.)  Ephemeral leaves are trace inputs, not
+    loop state — excluded."""
+    eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+    out = []
+    for k in sorted(state):
+        if k in eph:
+            continue
+        if np.dtype(state[k].dtype).kind == "f":
+            out.append(no_nan(k))
+    return out

@@ -1,0 +1,126 @@
+"""Divergence watchdog: carry-digest cycle proof + residual stagnation.
+
+The superstep is a *deterministic* function of the carry (XLA trace,
+fixed reduction order).  So if the carry digest at round r equals the
+digest at round r0 < r, the run is provably in an infinite cycle of
+period r - r0 — state r+1 will equal state r0+1, and so on forever.
+One repeat is a proof, not a heuristic (modulo digest collisions; the
+digest below keeps 64 bits per carry leaf, so a false cycle verdict
+needs a 2^-64 event per leaf).
+
+Residual stagnation is the heuristic companion for float carries whose
+digests never repeat but whose residual (max |Δ| between consecutive
+probes) stops improving: a PageRank-like iteration whose residual has
+not made a new minimum in `window` probes is burning rounds without
+converging.  The window is generous by default (a long-diameter
+BFS/SSSP legitimately plateaus its residual for `diameter` rounds) and
+0 disables the check; cycle detection stays on regardless.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _u32_words(v) -> jnp.ndarray:
+    """Flatten one carry leaf to its uint32 bit-words (exact: two
+    states digest equal iff their bytes are equal, leaf by leaf)."""
+    v = jnp.asarray(v)
+    if v.dtype == jnp.bool_ or v.dtype.itemsize < 4:
+        # sub-word leaves digest by value, which is still injective
+        return v.astype(jnp.uint32).reshape(-1)
+    return lax.bitcast_convert_type(v, jnp.uint32).reshape(-1)
+
+
+def carry_digest(carry: Dict) -> jnp.ndarray:
+    """[2 * nleaves] uint32 digest of the carry, order-sensitive
+    within each leaf: two independent position-weighted wrapping sums
+    (64 digest bits per leaf), per leaf in sorted-key order.  Plain
+    multiply-add reductions only — XLA lowers them everywhere, unlike
+    custom xor reduce computations.  Cheap enough to run every probe on
+    device; fetched to the host as a hashable tuple."""
+    words = []
+    for k in sorted(carry):
+        bits = _u32_words(carry[k])
+        pos = jnp.arange(bits.shape[0], dtype=jnp.uint32)
+        # Knuth/Murmur odd multipliers make each sum order-sensitive
+        # and mutually independent
+        w1 = pos * jnp.uint32(2654435761) + jnp.uint32(1)
+        w2 = pos * jnp.uint32(0x85EBCA77) + jnp.uint32(0x9E3779B1)
+        mixed = bits ^ (bits >> 16)
+        words.append(jnp.sum(bits * w1))  # uint32 wraparound
+        words.append(jnp.sum(mixed * w2))
+    return jnp.stack(words)
+
+
+def digest_hex(digest: Tuple[int, ...]) -> str:
+    return "".join(f"{int(w) & 0xFFFFFFFF:08x}" for w in digest)
+
+
+class DivergenceWatchdog:
+    """Observes (round, digest, residual) at every probe and returns a
+    verdict dict when the run provably cycles or heuristically
+    stagnates; None while healthy.  `reset()` after a rollback —
+    replayed rounds would otherwise re-present digests the history
+    already holds and fire a false cycle verdict."""
+
+    def __init__(self, stagnation_window: int = 256):
+        self.stagnation_window = stagnation_window
+        self._seen: Dict[Tuple[int, ...], int] = {}
+        self._best_residual: Optional[float] = None
+        self._stale_probes = 0
+
+    def reset(self) -> None:
+        self._seen.clear()
+        self._best_residual = None
+        self._stale_probes = 0
+
+    def observe(
+        self,
+        rounds: int,
+        digest: Tuple[int, ...],
+        residual: Optional[float] = None,
+    ) -> Optional[dict]:
+        first = self._seen.get(digest)
+        if first is not None:
+            return {
+                "kind": "oscillation",
+                "period": rounds - first,
+                "first_seen_round": first,
+                "round": rounds,
+                "detail": (
+                    f"carry digest at superstep {rounds} repeats superstep "
+                    f"{first}: the loop is in a provable cycle of period "
+                    f"{rounds - first} and will never converge"
+                ),
+            }
+        self._seen[digest] = rounds
+        if residual is not None and self.stagnation_window > 0:
+            if (
+                self._best_residual is None
+                or (np.isfinite(residual) and residual < self._best_residual)
+            ):
+                self._best_residual = (
+                    float(residual) if np.isfinite(residual) else None
+                )
+                self._stale_probes = 0
+            else:
+                self._stale_probes += 1
+                if self._stale_probes >= self.stagnation_window:
+                    return {
+                        "kind": "stagnation",
+                        "round": rounds,
+                        "best_residual": self._best_residual,
+                        "stale_probes": self._stale_probes,
+                        "detail": (
+                            f"residual has not improved on "
+                            f"{self._best_residual!r} for "
+                            f"{self._stale_probes} probes "
+                            f"(window {self.stagnation_window})"
+                        ),
+                    }
+        return None

@@ -106,6 +106,18 @@ class BFS(ParallelAppBase):
         active = ctx.sum(changed.sum().astype(jnp.int32))
         return {"depth": new}, active
 
+    def invariants(self, frag, state):
+        # levels live in [0, SENTINEL] and only ever improve (pull-mode
+        # unit-weight relaxation is tropical-min, like SSSP)
+        from libgrape_lite_tpu.guard.invariants import (
+            in_range, monotone_non_increasing,
+        )
+
+        return [
+            in_range("depth", lo=0, hi=_SENTINEL),
+            monotone_non_increasing("depth"),
+        ]
+
     def finalize(self, frag, state):
         d = np.asarray(state["depth"]).astype(np.int64)
         return np.where(d == _SENTINEL, _OUT_SENTINEL, d)

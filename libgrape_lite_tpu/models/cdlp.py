@@ -204,6 +204,36 @@ class CDLP(ParallelAppBase):
         active = jnp.where(step >= jnp.int32(self.max_round), jnp.int32(0), jnp.int32(1))
         return dict(state, labels=labels, step=step), active
 
+    def invariants(self, frag, state):
+        # Labels are NOT monotone under mode adoption (the most
+        # frequent neighbor label can exceed the current one — that is
+        # why CDLP runs a fixed round budget), so the sound invariant
+        # is universe membership: every label is an id that existed at
+        # init (<= the max initial label) or the pad sentinel.
+        from libgrape_lite_tpu.guard.invariants import Invariant
+
+        def in_universe(dev, prev, cur):
+            lab = cur["labels"]
+            dt = lab.dtype
+            big = jnp.asarray(np.iinfo(np.dtype(dt).name).max, dt)
+            # the largest real id in the sorted universe (the lut also
+            # holds one sentinel per padded row, so filter rather than
+            # index from the end)
+            lut = cur["lut"]
+            max_id = jnp.max(jnp.where(lut < big, lut, jnp.asarray(-1, dt)))
+            ok = jnp.logical_and(
+                lab >= 0,
+                jnp.logical_or(lab <= max_id, lab == big),
+            )
+            nbad = (~ok).sum().astype(jnp.int32)
+            return nbad == 0, nbad.astype(jnp.float32)
+
+        return [Invariant(
+            "cdlp_label_universe", in_universe, ("labels", "lut"),
+            "labels stay within the initial id universe (or the pad "
+            "sentinel)",
+        )]
+
     def finalize(self, frag, state):
         labels = np.asarray(state["labels"])
         if frag.is_string_keyed():

@@ -222,5 +222,12 @@ class LCC(ParallelAppBase):
     def inceval(self, ctx: StepContext, frag, state):
         return state, jnp.int32(0)
 
+    def invariants(self, frag, state):
+        # a clustering coefficient is a triangle fraction: [0, 1] on a
+        # deduplicated simple graph (in_range also rejects NaN)
+        from libgrape_lite_tpu.guard.invariants import in_range
+
+        return [in_range("lcc", lo=0.0, hi=1.0)]
+
     def finalize(self, frag, state):
         return np.asarray(state["lcc"])

@@ -210,5 +210,38 @@ class PageRank(BatchShuffleAppBase):
         cur = segment_sum_auto(contrib, ie.edge_src, frag.vp, plan).astype(dt)
         return self.round_update(frag, state, cur)
 
+    # PageRank is a probability distribution: within each round the
+    # stored form is rank/deg (dangling vertices hold the raw base), so
+    # the conserved quantity is sum(deg>0 ? rank*deg : rank) == 1; the
+    # final round multiplies the degree back in, making it sum(rank).
+    # The tolerance absorbs f32 segment-sum error at RMAT-20 scale.
+    mass_rtol = 1e-3
+
+    def invariants(self, frag, state):
+        from libgrape_lite_tpu.guard.invariants import (
+            Invariant, finite, in_range,
+        )
+
+        mr = self.max_round
+        rtol = self.mass_rtol
+
+        def mass_fn(dev, prev, cur):
+            rank = cur["rank"]
+            dt = rank.dtype
+            deg = dev.out_degree.astype(dt)
+            iter_mass = jnp.where(deg > 0, rank * deg, rank).sum()
+            is_final = cur["step"] >= jnp.int32(mr)
+            mass = jnp.where(is_final, rank.sum(), iter_mass)
+            err = jnp.abs(mass - jnp.asarray(1.0, dt))
+            return err <= jnp.asarray(rtol, dt), err
+
+        out = [finite("rank"), in_range("rank", lo=0.0)]
+        if mr > 0:  # a 0-round query never leaves the rank/deg form
+            out.append(Invariant(
+                "pagerank_mass", mass_fn, ("rank", "step"),
+                f"total probability mass conserved within {rtol:g}",
+            ))
+        return out
+
     def finalize(self, frag, state):
         return np.asarray(state["rank"])

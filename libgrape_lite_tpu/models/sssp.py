@@ -143,5 +143,18 @@ class SSSP(ParallelAppBase):
         active = ctx.sum(changed.sum().astype(jnp.int32))
         return {"dist": new}, active
 
+    def invariants(self, frag, state):
+        # distances are tropical-min state: never negative, never NaN
+        # (in_range(lo=0) rejects NaN — NaN >= 0 is False), and only
+        # ever improving; +inf is the legitimate unreached sentinel
+        from libgrape_lite_tpu.guard.invariants import (
+            in_range, monotone_non_increasing,
+        )
+
+        return [
+            in_range("dist", lo=0.0),
+            monotone_non_increasing("dist"),
+        ]
+
     def finalize(self, frag, state):
         return np.asarray(state["dist"])

@@ -131,6 +131,18 @@ class WCC(ParallelAppBase):
         active = ctx.sum(changed.sum().astype(jnp.int32))
         return {"comp": new}, active
 
+    def invariants(self, frag, state):
+        # min-gid propagation: labels are pids (or the pad sentinel)
+        # and only ever shrink toward the component representative
+        from libgrape_lite_tpu.guard.invariants import (
+            in_range, monotone_non_increasing,
+        )
+
+        return [
+            in_range("comp", lo=0, hi=np.iinfo(np.int32).max),
+            monotone_non_increasing("comp"),
+        ]
+
     def finalize(self, frag, state):
         comp = np.asarray(state["comp"]).astype(np.int64)
         # canonicalise: component id -> oid of representative pid

@@ -50,6 +50,7 @@ class QueryArgs:
     checkpoint_every: int = 0  # ft/: superstep checkpoint cadence (0 = off)
     checkpoint_dir: str = ""
     resume: bool = False  # continue from the last complete checkpoint
+    guard: str = ""  # guard/: breach policy ("" reads GRAPE_GUARD)
     profile: bool = False
     serialize: bool = False
     deserialize: bool = False
@@ -209,6 +210,7 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
 
             if glog._level < 1:
                 glog.set_vlog_level(1)  # --profile exists to show timings
+        guard = args.guard or None  # None -> GRAPE_GUARD env
         if args.resume:
             # query args replay from the checkpoint metadata (the
             # fingerprint guarantees they match this invocation's app +
@@ -216,17 +218,19 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
             worker.resume(
                 args.checkpoint_dir,
                 checkpoint_every=args.checkpoint_every or None,
+                guard=guard,
             )
         elif args.checkpoint_every:
             worker.query(
                 checkpoint_every=args.checkpoint_every,
                 checkpoint_dir=args.checkpoint_dir,
+                guard=guard,
                 **kw,
             )
         elif args.profile and not getattr(app, "host_only", False):
-            worker.query_stepwise(**kw)
+            worker.query_stepwise(guard=guard, **kw)
         else:
-            worker.query(**kw)
+            worker.query(guard=guard, **kw)
 
     if args.memory_stats:
         from libgrape_lite_tpu.utils.memory import get_memory_stats

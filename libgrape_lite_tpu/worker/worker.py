@@ -77,6 +77,16 @@ class Worker:
         self.rounds = 0
         self._result_state = None
         self._terminate_code = 0
+        self._guard_monitor = None  # guard/: set only while guards are armed
+
+    @property
+    def guard_report(self):
+        """The last query's guard statistics (probes, breaches,
+        rollbacks) or None when guards were off."""
+        return (
+            None if self._guard_monitor is None
+            else self._guard_monitor.report()
+        )
 
     def get_terminate_info(self):
         """(success, info) — reference `Worker::GetTerminateInfo`
@@ -173,6 +183,73 @@ class Worker:
 
         return compile_for
 
+    def _make_chunk_runner(self, chunk: int, max_rounds: int):
+        """Fused IncEval segment for the guarded path: runs up to
+        `chunk` supersteps of the SAME `shard_map(while_loop)` body as
+        `_make_runner`, but (a) skips PEval (the caller drives it once),
+        (b) enters/exits at an arbitrary (round, active) so segments
+        compose, and (c) does NOT donate the carry — the guard probe
+        reads the pre-chunk carry for the consecutive-carry invariants
+        (monotone distances etc.), so guarded execution holds two carry
+        generations in HBM by design."""
+        app = self.app
+        mesh, frag_spec = self._mesh_layout()
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+
+        def stepper(frag_stacked, state, eph_state, active0, r0, squeezed):
+            frag = frag_stacked.local()
+            st_all = _squeeze_state({**state, **eph_state}, squeezed)
+            eph_vals = {k: st_all[k] for k in eph}
+
+            def strip(s):
+                return {k: v for k, v in s.items() if k not in eph}
+
+            ctx = StepContext()
+            st = strip(st_all)
+            limit = jnp.int32(max_rounds if max_rounds > 0 else _INT32_MAX)
+            stop = jnp.minimum(jnp.int32(r0) + jnp.int32(chunk), limit)
+
+            def cond(carry):
+                _, act, r = carry
+                return jnp.logical_and(act > 0, r < stop)
+
+            def body(carry):
+                s, _, r = carry
+                s2, a2 = app.inceval(ctx, frag, {**s, **eph_vals})
+                return strip(s2), jnp.int32(a2), r + jnp.int32(1)
+
+            st, active, rounds = lax.while_loop(
+                cond, body, (st, jnp.int32(active0), jnp.int32(r0))
+            )
+            return _unsqueeze_state(st, squeezed), rounds, active
+
+        def compile_for(state):
+            specs, squeezed = self._key_specs(state)
+            carry_specs = {k: v for k, v in specs.items() if k not in eph}
+            eph_specs = {k: v for k, v in specs.items() if k in eph}
+            sm = compat.shard_map(
+                partial(stepper, squeezed=squeezed),
+                mesh=mesh,
+                in_specs=(frag_spec, carry_specs, eph_specs, P(), P()),
+                out_specs=(carry_specs, P(), P()),
+                check_vma=False,
+            )
+            return jax.jit(sm)
+
+        return compile_for
+
+    def _chunk_runner_for(self, chunk: int, max_rounds: int, state):
+        key = (
+            "chunk", chunk, max_rounds,
+            self.app.trace_key(),
+            tuple(sorted((k, v.shape, str(v.dtype)) for k, v in state.items())),
+        )
+        if key not in self._runner_cache:
+            self._runner_cache[key] = self._make_chunk_runner(
+                chunk, max_rounds
+            )(state)
+        return self._runner_cache[key]
+
     def _runner_for(self, max_rounds: int, state):
         """Cache the jitted runner per (max_rounds, app hyperparameters,
         state structure) so repeated queries don't re-trace but changed
@@ -189,22 +266,52 @@ class Worker:
     def query(self, max_rounds: int | None = None, *,
               checkpoint_every: int | None = None,
               checkpoint_dir: str | None = None,
-              fault_plan=None, **query_args):
+              fault_plan=None, guard=None, **query_args):
         """Run one query (reference `Worker::Query`, worker.h:104-146).
 
         `checkpoint_every=K` + `checkpoint_dir` degrade the fused loop
         to stepwise execution with a carry snapshot every K supersteps
         (ft/checkpoint.py); `checkpoint_every=None` (default) leaves
-        the fused `shard_map(while_loop)` fast path untouched."""
+        the fused `shard_map(while_loop)` fast path untouched.
+
+        `guard` arms the runtime invariant monitor (guard/):
+        GuardConfig, a policy string ("warn"|"halt"|"rollback"), or
+        None to read GRAPE_GUARD from the env.  With guards off (the
+        default) this method compiles exactly the trace it always has —
+        the guard decision is a host-side env read, so the fused fast
+        path is byte-identical and zero-overhead.  Guards on: the loop
+        runs in fused chunks of GRAPE_GUARD_EVERY supersteps with an
+        invariant probe + watchdog digest at every boundary."""
         if checkpoint_every is not None or checkpoint_dir is not None:
             return self.query_stepwise(
                 max_rounds, checkpoint_every=checkpoint_every,
                 checkpoint_dir=checkpoint_dir, fault_plan=fault_plan,
-                **query_args,
+                guard=guard, **query_args,
             )
         app = self.app
         frag = self.fragment
         mr = app.max_rounds if max_rounds is None else max_rounds
+        self._guard_monitor = None
+
+        from libgrape_lite_tpu.guard.config import GuardConfig
+
+        guard_cfg = GuardConfig.resolve(guard)
+        if guard_cfg.enabled:
+            if getattr(app, "host_only", False):
+                from libgrape_lite_tpu.utils import logging as glog
+
+                glog.log_info(
+                    "guard: host-only apps have no superstep carry to "
+                    "monitor; guards are inert for "
+                    f"{type(app).__name__}"
+                )
+            elif hasattr(app, "collect_mutations"):
+                # stepwise handles (and logs) the mutation restriction
+                return self.query_stepwise(
+                    max_rounds, guard=guard, **query_args
+                )
+            else:
+                return self._query_guarded(mr, guard_cfg, **query_args)
 
         if getattr(app, "host_only", False):
             # host-engine apps (irregular recursion, e.g. kclique) skip
@@ -235,6 +342,69 @@ class Worker:
         self._terminate_code = min(0, int(active))
         self._result_state = out_state
         return out_state
+
+    def _query_guarded(self, mr: int, guard_cfg, **query_args):
+        """Guarded-fused query: PEval once, then fused IncEval chunks
+        of `guard_cfg.every` supersteps with an invariant probe +
+        watchdog digest at every chunk boundary — a breach is detected
+        within one cadence while the inner loop stays the fused
+        `shard_map(while_loop)`.  Policies: warn logs and continues,
+        halt raises with the diagnostic bundle; rollback degrades to
+        halt here (snapshots require the checkpointed stepwise path —
+        the monitor logs the downgrade)."""
+        from libgrape_lite_tpu.guard.monitor import GuardMonitor
+        from libgrape_lite_tpu.utils import logging as glog
+
+        app = self.app
+        frag = self.fragment
+        if mr <= 0:  # 0 = run until the termination vote fires
+            mr = _INT32_MAX
+        state = self._place_state(app.init_state(frag, **query_args))
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        eph_part = {k: v for k, v in state.items() if k in eph}
+
+        def carry_of(st):
+            return {k: v for k, v in st.items() if k not in eph}
+
+        monitor = GuardMonitor(
+            app=app, frag=frag, config=guard_cfg,
+            ledger=self.pack_ledger(),
+        )
+        self._guard_monitor = monitor
+        glog.vlog(
+            1,
+            f"guard: fused chunks of {guard_cfg.every} supersteps "
+            f"(policy={guard_cfg.policy})",
+        )
+
+        def observe(prev, cur, rounds, active):
+            if active < 0:  # cooperative abort is the app's own verdict
+                return
+            breach = monitor.check(prev, cur, rounds, active)
+            if breach is not None:
+                # rollback needs a checkpointed stepwise run; the
+                # monitor already downgraded + logged, so anything
+                # surviving a warn policy halts here
+                monitor.raise_breach(breach)
+
+        peval_fn = self._compile_single_step("peval", state)
+        prev = carry_of(state)
+        carry, active = jax.block_until_ready(peval_fn(frag.dev, state))
+        rounds = 0
+        observe(prev, carry, rounds, int(active))
+        chunk_fn = self._chunk_runner_for(guard_cfg.every, mr, state)
+        while int(active) > 0 and rounds < mr:
+            prev = carry
+            carry, r2, active = jax.block_until_ready(
+                chunk_fn(frag.dev, carry, eph_part,
+                         jnp.int32(int(active)), jnp.int32(rounds))
+            )
+            rounds = int(r2)
+            observe(prev, carry, rounds, int(active))
+        self.rounds = rounds
+        self._terminate_code = min(0, int(active))
+        self._result_state = {**carry, **eph_part}
+        return self._result_state
 
     def _place_state(self, state_np):
         """Place the init state: sharded leaves over the frag axis,
@@ -283,7 +453,7 @@ class Worker:
     def query_stepwise(self, max_rounds: int | None = None, *,
                        checkpoint_every: int | None = None,
                        checkpoint_dir: str | None = None,
-                       fault_plan=None, _resume: bool = False,
+                       fault_plan=None, guard=None, _resume: bool = False,
                        **query_args):
         """Host-driven query: one jitted superstep per round with
         per-round wall time + termination-vote logs — the observable
@@ -347,6 +517,11 @@ class Worker:
             fault_plan = active_plan()
         if fault_plan.is_noop():
             fault_plan = None
+
+        from libgrape_lite_tpu.guard.config import GuardConfig
+
+        guard_cfg = GuardConfig.resolve(guard)
+        self._guard_monitor = None
 
         state_np = app.init_state(frag, **query_args)
         eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
@@ -414,9 +589,37 @@ class Worker:
         def carry_of(st):
             return {k: v for k, v in st.items() if k not in eph}
 
+        monitor = None
+        if guard_cfg.enabled:
+            if has_mutations:
+                glog.log_info(
+                    "guard: disabled for MutationContext apps (the "
+                    "fragment changes between rounds, so a probe cannot "
+                    "span a rebuild)"
+                )
+            else:
+                from libgrape_lite_tpu.guard.monitor import GuardMonitor
+
+                monitor = GuardMonitor(
+                    app=app, frag=frag, config=guard_cfg, ckpt=ckpt,
+                    ledger=self.pack_ledger(),
+                )
+                self._guard_monitor = monitor
+                glog.vlog(
+                    1,
+                    f"guard: stepwise probes every {guard_cfg.every} "
+                    f"round(s) (policy={guard_cfg.policy})",
+                )
+
+        # the monotone invariants compare against the carry of the LAST
+        # probe (not the last round): with a probe cadence > 1 an
+        # in-gap increase that settles into a new fixed point would
+        # otherwise slip past round-to-round comparison
+        guard_prev = None
         if resume_meta is not None:
             rounds = int(resume_meta["rounds"])
             active = np.int32(resume_meta["active"])
+            guard_prev = carry_of(state) if monitor is not None else None
             glog.vlog(
                 1,
                 f"resumed from superstep {rounds} "
@@ -424,6 +627,7 @@ class Worker:
             )
         else:
             peval_fn = self._compile_single_step("peval", state)
+            prev_carry = carry_of(state) if monitor is not None else None
             t0 = time.perf_counter()
             state, active = jax.block_until_ready(peval_fn(frag.dev, state))
             state = {**state, **eph_vals}
@@ -431,6 +635,25 @@ class Worker:
                 1, f"PEval: {time.perf_counter() - t0:.6f}s active={int(active)}"
             )
             rounds = 0
+            if fault_plan is not None:
+                # injected device-state corruption lands BEFORE the
+                # probe (so detection is same-round) and before the
+                # save (warn-policy runs aside, a corrupt state never
+                # becomes the snapshot a rollback would restore)
+                corrupted = fault_plan.maybe_corrupt_carry(
+                    carry_of(state), 0
+                )
+                if corrupted is not None:
+                    state = {**state, **self._place_state(corrupted)}
+            if monitor is not None and int(active) >= 0 and monitor.due(0):
+                # a PEval breach has no snapshot to restore — any
+                # non-warn verdict halts
+                breach = monitor.check(
+                    prev_carry, carry_of(state), 0, int(active)
+                )
+                if breach is not None:
+                    monitor.raise_breach(breach)
+                guard_prev = carry_of(state)
             if ckpt is not None:
                 # a superstep-0 snapshot always exists, so a kill at any
                 # later round has something to fall back to
@@ -481,6 +704,43 @@ class Worker:
                     f"IncEval round {rounds}: "
                     f"{time.perf_counter() - t0:.6f}s active={int(active)}",
                 )
+                if fault_plan is not None:
+                    # corruption lands BEFORE the probe: detection is
+                    # same-round even for carries a further superstep
+                    # would wash clean (CDLP mode adoption)
+                    corrupted = fault_plan.maybe_corrupt_carry(
+                        carry_of(state), rounds
+                    )
+                    if corrupted is not None:
+                        state = {**state, **self._place_state(corrupted)}
+                # the probe runs BEFORE the cadence save — and is
+                # FORCED on checkpoint rounds even when the guard
+                # cadence would skip them: a state that fails its
+                # invariants must never become the snapshot a later
+                # rollback restores (a rollback `continue` also skips
+                # this round's save and injection hooks)
+                ckpt_round = (
+                    ckpt is not None and rounds % checkpoint_every == 0
+                )
+                if (
+                    monitor is not None and int(active) >= 0
+                    and (monitor.due(rounds) or ckpt_round)
+                ):
+                    breach = monitor.check(
+                        guard_prev, carry_of(state), rounds, int(active)
+                    )
+                    if breach is not None:
+                        if breach.action == "rollback":
+                            restored, meta = monitor.rollback(breach)
+                            state = {
+                                **state, **self._place_state(restored)
+                            }
+                            rounds = int(meta["rounds"])
+                            active = np.int32(meta["active"])
+                            guard_prev = carry_of(state)
+                            continue
+                        monitor.raise_breach(breach)
+                    guard_prev = carry_of(state)
                 if ckpt is not None and rounds % checkpoint_every == 0:
                     ckpt.save_async(carry_of(state), rounds, int(active))
                 if fault_plan is not None:
@@ -553,7 +813,8 @@ class Worker:
         return out
 
     def resume(self, checkpoint_dir: str, max_rounds: int | None = None, *,
-               checkpoint_every: int | None = None, fault_plan=None):
+               checkpoint_every: int | None = None, fault_plan=None,
+               guard=None):
         """Continue a checkpointed query from the last complete
         superstep.  The config fingerprint (app, fragment content, mesh
         shape, query args, numeric config) is validated before any
@@ -584,7 +845,7 @@ class Worker:
         return self.query_stepwise(
             max_rounds, checkpoint_every=checkpoint_every,
             checkpoint_dir=checkpoint_dir, fault_plan=fault_plan,
-            _resume=True, **query_args,
+            guard=guard, _resume=True, **query_args,
         )
 
     # ---- Output / Assemble (reference worker.h:148-154, ctx.Output) ----

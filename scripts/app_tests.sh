@@ -85,4 +85,14 @@ SER="$OUT/serial"
 run 2 pagerank --pr_mr=10 --serialize --serialization_prefix "$SER"; verify eps p2p-31-PR
 run 2 pagerank --pr_mr=10 --deserialize --serialization_prefix "$SER"; verify eps p2p-31-PR
 
+echo "== load validation gate (fnum=2) =="
+# subshell: `VAR=x fn` would leak past the bash function call
+( export GRAPE_VALIDATE_LOAD=1; run 2 wcc ); verify wcc p2p-31-WCC
+
+echo "== guarded run, goldens unchanged (fnum=2) =="
+run 2 sssp --sssp_source=6 --guard=halt; verify exact p2p-31-SSSP
+
+echo "== guard self-heal drill (corrupt_carry + rollback-replay) =="
+python scripts/fault_drill.py --self-heal --apps sssp,pagerank,wcc
+
 echo "ALL APP TESTS PASSED"

@@ -2,7 +2,10 @@
 """Kill/resume fault drill — the end-to-end proof that checkpoint
 recovery works, runnable as a CI smoke check.
 
-Per app (default: sssp, pagerank, cdlp on dataset/p2p-31):
+Two modes:
+
+**kill/resume** (default) — per app (default: sssp, pagerank, cdlp on
+dataset/p2p-31):
 
   1. **reference** — an uninterrupted checkpointed run writes its
      per-fragment result files.
@@ -18,10 +21,24 @@ Per app (default: sssp, pagerank, cdlp on dataset/p2p-31):
   5. **verify** — the resumed output must be byte-identical to the
      reference output.
 
+**self-heal** (`--self-heal`, default apps: sssp, pagerank, wcc) — the
+guard/ closed loop, end-to-end through the real CLI:
+
+  1. **reference** — an uninterrupted checkpointed run writes its
+     per-fragment result files.
+  2. **heal** — the same run re-executes armed with
+     `GRAPE_FT_FAULTS=corrupt_carry@K` and `GRAPE_GUARD=rollback`: the
+     injected device-state corruption must be detected by the app's
+     invariants within one cadence, rolled back to the last good
+     snapshot, replayed in paranoid mode, and the process must exit 0.
+  3. **verify** — the healed output must be byte-identical to the
+     reference, and the log must show the breach + rollback markers.
+
 Exit code 0 iff every app passes.  Usage:
 
-    python scripts/fault_drill.py                 # all three apps
+    python scripts/fault_drill.py                 # kill/resume, 3 apps
     python scripts/fault_drill.py --apps sssp --corrupt
+    python scripts/fault_drill.py --self-heal     # guard rollback drill
 """
 
 from __future__ import annotations
@@ -47,6 +64,7 @@ APP_FLAGS = {
 def run_cli(extra, env_overrides=None, timeout=600):
     env = dict(os.environ)
     env.pop("GRAPE_FT_FAULTS", None)
+    env.pop("GRAPE_GUARD", None)  # ambient guards must not leak in
     env.update(env_overrides or {})
     cmd = [sys.executable, "-m", "libgrape_lite_tpu.cli"] + extra
     proc = subprocess.run(
@@ -144,10 +162,77 @@ def drill(app: str, args, workdir: str) -> bool:
     return True
 
 
+def self_heal_drill(app: str, args, workdir: str) -> bool:
+    """corrupt_carry@K + GRAPE_GUARD=rollback must self-heal to
+    byte-identical results through the real CLI."""
+    import re
+
+    wd = os.path.join(workdir, f"heal_{app}")
+    os.makedirs(wd, exist_ok=True)
+    base = [
+        "--application", app,
+        "--efile", args.efile, "--vfile", args.vfile,
+        "--platform", "cpu", "--cpu_devices", str(args.cpu_devices),
+        "--checkpoint_every", str(args.checkpoint_every),
+    ] + APP_FLAGS.get(app, [])
+
+    out_ref = os.path.join(wd, "out_ref")
+    rc, log = run_cli(base + [
+        "--checkpoint_dir", os.path.join(wd, "ck_ref"),
+        "--out_prefix", out_ref,
+    ])
+    if rc != 0:
+        print(f"[{app}] FAIL: reference run rc={rc}\n{log}")
+        return False
+
+    out_heal = os.path.join(wd, "out_heal")
+    rc, log = run_cli(
+        base + [
+            "--checkpoint_dir", os.path.join(wd, "ck_heal"),
+            "--out_prefix", out_heal, "--guard", "rollback",
+        ],
+        env_overrides={
+            "GRAPE_FT_FAULTS": f"corrupt_carry@{args.corrupt_carry_at}",
+        },
+    )
+    if rc != 0:
+        print(f"[{app}] FAIL: self-heal run rc={rc}\n{log}")
+        return False
+
+    m = re.search(r"invariant breach at superstep (\d+)", log)
+    if not m:
+        print(f"[{app}] FAIL: injected corruption was never detected\n{log}")
+        return False
+    breach_at = int(m.group(1))
+    if breach_at - args.corrupt_carry_at > args.checkpoint_every:
+        print(
+            f"[{app}] FAIL: breach detected at superstep {breach_at}, "
+            f"more than one cadence after the injection at "
+            f"{args.corrupt_carry_at}"
+        )
+        return False
+    if "rolled back to superstep" not in log:
+        print(f"[{app}] FAIL: breach detected but no rollback ran\n{log}")
+        return False
+
+    problems = compare_outputs(out_ref, out_heal)
+    if problems:
+        print(f"[{app}] FAIL: " + "; ".join(problems))
+        return False
+    print(
+        f"[{app}] PASS: corrupt_carry@{args.corrupt_carry_at} detected at "
+        f"superstep {breach_at}, rolled back, replayed; healed run is "
+        f"byte-identical to the fault-free one"
+    )
+    return True
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("--apps", default="sssp,pagerank,cdlp",
-                   help="comma-separated app list")
+    p.add_argument("--apps", default="",
+                   help="comma-separated app list (default: "
+                        "sssp,pagerank,cdlp — or sssp,pagerank,wcc "
+                        "with --self-heal)")
     p.add_argument("--efile", default=os.path.join(REPO, "dataset", "p2p-31.e"))
     p.add_argument("--vfile", default=os.path.join(REPO, "dataset", "p2p-31.v"))
     p.add_argument("--kill_at", type=int, default=4,
@@ -158,15 +243,26 @@ def main() -> int:
                    help="also corrupt the newest shard before resuming "
                         "(exercises the fallback to the previous "
                         "complete superstep)")
+    p.add_argument("--self-heal", dest="self_heal", action="store_true",
+                   help="guard/ drill: inject corrupt_carry@K with "
+                        "GRAPE_GUARD=rollback and verify detection, "
+                        "rollback-replay, and byte-identical results")
+    p.add_argument("--corrupt_carry_at", type=int, default=4,
+                   help="superstep for the corrupt_carry injection "
+                        "(--self-heal)")
     p.add_argument("--workdir", default="",
                    help="working directory (default: a fresh temp dir, "
                         "removed on success)")
     args = p.parse_args()
 
+    if not args.apps:
+        args.apps = "sssp,pagerank,wcc" if args.self_heal \
+            else "sssp,pagerank,cdlp"
     workdir = args.workdir or tempfile.mkdtemp(prefix="grape-fault-drill-")
+    run_one = self_heal_drill if args.self_heal else drill
     ok = True
     for app in filter(None, args.apps.split(",")):
-        ok = drill(app.strip(), args, workdir) and ok
+        ok = run_one(app.strip(), args, workdir) and ok
     if ok and not args.workdir:
         shutil.rmtree(workdir, ignore_errors=True)
     else:

@@ -282,6 +282,52 @@ def test_capacity_fault_forces_overflow_recovery(monkeypatch):
     np.testing.assert_array_equal(wk.result_values(), want)
 
 
+def test_gc_tolerates_concurrent_removal(tmp_path, monkeypatch):
+    """Retention must never take down a healthy run: a concurrent
+    cleaner may delete checkpoint entries (or the whole directory)
+    between the listing and the rmtree — _gc and subsequent saves
+    tolerate it."""
+    import shutil as _sh
+
+    import numpy as _np
+
+    from libgrape_lite_tpu.ft import checkpoint as ck
+    from libgrape_lite_tpu.ft.checkpoint import CheckpointManager
+
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(
+        d, fingerprint={"app": "t"}, query_args={}, checkpoint_every=1,
+        keep=1,
+    )
+    state = {"x": _np.arange(8)}
+    for r in (0, 1, 2):
+        mgr.save_async(state, r, 1)
+        mgr.wait()
+
+    # entries vanish mid-sweep: listing returns paths a racing cleaner
+    # already removed
+    real_list = ck.list_checkpoints
+
+    def racing_list(directory):
+        steps = real_list(directory)
+        for _, p in steps[:-1]:
+            _sh.rmtree(p, ignore_errors=True)
+        return steps
+
+    monkeypatch.setattr(ck, "list_checkpoints", racing_list)
+    mgr._gc()  # must not raise
+    monkeypatch.setattr(ck, "list_checkpoints", real_list)
+
+    # the whole directory vanishes between saves: the next save
+    # recreates it and the run keeps going
+    _sh.rmtree(d)
+    mgr.save_async(state, 3, 1)
+    mgr.close()
+    from libgrape_lite_tpu.ft.checkpoint import list_checkpoints
+
+    assert [r for r, _ in list_checkpoints(d)] == [3]
+
+
 def test_resume_from_converged_checkpoint(graph_cache, tmp_path):
     """Resuming a checkpoint whose active vote is already 0 finishes
     immediately with the recorded state (idempotent resume)."""

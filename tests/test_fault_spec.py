@@ -1,0 +1,97 @@
+"""FaultPlan spec parsing: every grammar form round-trips, and an
+unknown or malformed token raises the typed FaultSpecError listing the
+supported specs (a typo like `kil@3` must never parse to a silent
+no-op plan)."""
+
+import pytest
+
+from libgrape_lite_tpu.ft.faults import (
+    DEFAULT_KILL_EXIT_CODE,
+    FaultPlan,
+    FaultSpecError,
+)
+
+
+def test_each_spec_form_parses():
+    assert FaultPlan.from_spec("kill@4").kill_at_superstep == 4
+    assert FaultPlan.from_spec("corrupt@2").corrupt_checkpoint_at == 2
+    assert FaultPlan.from_spec("corrupt_carry@5").corrupt_carry_at == 5
+    assert FaultPlan.from_spec("capacity=3").capacity_clamp == 3
+    assert FaultPlan.from_spec("capacity=0").capacity_clamp == 1  # clamped
+    assert FaultPlan.from_spec("mode=raise").mode == "raise"
+    assert FaultPlan.from_spec("mode=exit").mode == "exit"
+    assert FaultPlan.from_spec("exit=3").exit_code == 3
+    assert FaultPlan.from_spec("").is_noop()
+    assert FaultPlan.from_spec("kill@1").exit_code == DEFAULT_KILL_EXIT_CODE
+
+
+def test_combined_spec():
+    p = FaultPlan.from_spec("corrupt@6, kill@7, mode=raise")
+    assert (p.corrupt_checkpoint_at, p.kill_at_superstep, p.mode) == (
+        6, 7, "raise"
+    )
+    assert not p.is_noop()
+
+
+def test_corrupt_carry_is_not_noop_and_not_swallowed():
+    """corrupt_carry@ must not be prefix-parsed as corrupt@ ('_carry@K'
+    is not an int)."""
+    p = FaultPlan.from_spec("corrupt_carry@3")
+    assert p.corrupt_checkpoint_at is None
+    assert p.corrupt_carry_at == 3
+    assert not p.is_noop()
+
+
+@pytest.mark.parametrize("spec", [
+    "kil@3",            # the motivating typo
+    "corrupt_cary@3",
+    "bogus",
+    "kill@x",           # malformed int
+    "corrupt@",
+    "capacity=many",
+    "mode=wrong",
+    "exit=abc",
+])
+def test_bad_tokens_raise_typed_error(spec):
+    with pytest.raises(FaultSpecError) as ei:
+        FaultPlan.from_spec(spec)
+    # the error names the grammar so the fix is self-evident
+    assert "kill@K" in str(ei.value) and "corrupt_carry@K" in str(ei.value)
+
+
+def test_fault_spec_error_is_value_error():
+    """Call sites that caught ValueError keep working."""
+    with pytest.raises(ValueError):
+        FaultPlan.from_spec("kil@3")
+
+
+def test_env_arming(monkeypatch):
+    from libgrape_lite_tpu.ft.faults import FAULTS_ENV, active_plan
+
+    monkeypatch.setenv(FAULTS_ENV, "corrupt_carry@4,mode=raise")
+    p = active_plan()
+    assert p.corrupt_carry_at == 4 and p.mode == "raise"
+    monkeypatch.delenv(FAULTS_ENV)
+    assert active_plan().is_noop()
+
+
+def test_corrupt_carry_fires_once():
+    import numpy as np
+
+    p = FaultPlan(corrupt_carry_at=2)
+    carry = {"dist": np.zeros((2, 8), np.float64)}
+    assert p.maybe_corrupt_carry(carry, 1) is None
+    out = p.maybe_corrupt_carry(carry, 2)
+    assert out is not None and np.isnan(out["dist"][0]).any()
+    # the original is untouched (the worker re-places the copy)
+    assert not np.isnan(carry["dist"]).any()
+    # a rollback-replay passes superstep 2 again: no second injection
+    assert p.maybe_corrupt_carry(carry, 2) is None
+
+
+def test_corrupt_carry_int_leaf_goes_negative():
+    import numpy as np
+
+    p = FaultPlan(corrupt_carry_at=0)
+    out = p.maybe_corrupt_carry({"comp": np.zeros((2, 8), np.int32)}, 0)
+    assert out is not None and (out["comp"] < 0).any()

@@ -1,0 +1,509 @@
+"""guard/ tests: app invariants catch injected carry corruption, the
+divergence watchdog trips on oscillation/stagnation with a diagnostic
+bundle, breach policies (warn/halt/rollback) behave, the self-heal
+rollback-replay loop converges byte-identically, and the fused path
+with guards off is untouched."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from libgrape_lite_tpu.app.base import ParallelAppBase
+
+
+# ---- toy apps for the watchdog ------------------------------------------
+
+
+class Oscillator(ParallelAppBase):
+    """Two-state flip-flop: provably cycles with period 2 forever."""
+
+    max_rounds = 200
+
+    def init_state(self, frag, **_):
+        return {"x": np.zeros((frag.fnum, frag.vp), np.int32)}
+
+    def peval(self, ctx, frag, state):
+        return state, jnp.int32(1)
+
+    def inceval(self, ctx, frag, state):
+        return {"x": jnp.int32(1) - state["x"]}, jnp.int32(1)
+
+    def finalize(self, frag, state):
+        return np.asarray(state["x"])
+
+
+class Stagnator(ParallelAppBase):
+    """Votes active forever while its float state never moves: the
+    residual is 0 every round but a step counter keeps every digest
+    distinct, so only the stagnation heuristic can catch it."""
+
+    max_rounds = 200
+    replicated_keys = frozenset({"step"})
+
+    def init_state(self, frag, **_):
+        return {
+            "v": np.ones((frag.fnum, frag.vp), np.float64),
+            "step": np.int32(0),
+        }
+
+    def peval(self, ctx, frag, state):
+        return state, jnp.int32(1)
+
+    def inceval(self, ctx, frag, state):
+        return dict(state, step=state["step"] + jnp.int32(1)), jnp.int32(1)
+
+    def finalize(self, frag, state):
+        return np.asarray(state["v"])
+
+
+class BadVoter(ParallelAppBase):
+    """Votes an active count far beyond the vertex count — a corrupt
+    termination allreduce."""
+
+    max_rounds = 20
+
+    def init_state(self, frag, **_):
+        return {"x": np.zeros((frag.fnum, frag.vp), np.int32)}
+
+    def peval(self, ctx, frag, state):
+        return state, jnp.int32(1)
+
+    def inceval(self, ctx, frag, state):
+        return state, jnp.int32(10**9)
+
+    def finalize(self, frag, state):
+        return np.asarray(state["x"])
+
+
+def _toy_fragment(fnum=2):
+    from tests.test_worker import build_fragment
+
+    rng = np.random.default_rng(3)
+    n, e = 64, 256
+    return build_fragment(
+        rng.integers(0, n, e), rng.integers(0, n, e), rng.random(e), n, fnum
+    )
+
+
+# ---- watchdog ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stepwise", [False, True])
+def test_oscillation_trips_cycle_detection(stepwise):
+    from libgrape_lite_tpu.guard import DivergenceError, GuardConfig
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    w = Worker(Oscillator(), _toy_fragment())
+    cfg = GuardConfig(policy="halt", every=1)
+    with pytest.raises(DivergenceError) as ei:
+        if stepwise:
+            w.query_stepwise(guard=cfg)
+        else:
+            w.query(guard=cfg)
+    bundle = ei.value.bundle
+    assert bundle["verdict"]["kind"] == "oscillation"
+    assert bundle["verdict"]["period"] == 2
+    # halted long before max_rounds burned
+    assert bundle["round"] <= 4
+    # the structured diagnostic carries the run context
+    assert bundle["recent_digests"] and bundle["active_history"]
+    assert bundle["config_fingerprint"].get("fragment_hash")
+    assert bundle["guard_config"]["policy"] == "halt"
+
+
+def test_stagnation_halts_with_bundle():
+    from libgrape_lite_tpu.guard import DivergenceError, GuardConfig
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    w = Worker(Stagnator(), _toy_fragment())
+    cfg = GuardConfig(policy="halt", every=1, stagnation_window=6)
+    with pytest.raises(DivergenceError) as ei:
+        w.query_stepwise(guard=cfg)
+    v = ei.value.bundle["verdict"]
+    assert v["kind"] == "stagnation"
+    assert v["round"] <= 10  # window + slack, nowhere near max_rounds
+    assert v["best_residual"] == 0.0
+
+
+def test_stagnation_window_zero_disables():
+    from libgrape_lite_tpu.guard import GuardConfig
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    w = Worker(Stagnator(), _toy_fragment())
+    cfg = GuardConfig(policy="halt", every=1, stagnation_window=0)
+    w.query_stepwise(max_rounds=12, guard=cfg)  # runs the budget, no trip
+    assert w.rounds == 12
+
+
+def test_warn_policy_logs_and_continues():
+    from libgrape_lite_tpu.guard import GuardConfig
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    w = Worker(Oscillator(), _toy_fragment())
+    w.query(max_rounds=9, guard=GuardConfig(policy="warn", every=1))
+    assert w.rounds == 9  # ran to the budget despite the cycle verdicts
+    assert w.guard_report["breaches"]
+
+
+def test_bad_active_vote_is_a_breach():
+    from libgrape_lite_tpu.guard import GuardConfig, InvariantBreachError
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    w = Worker(BadVoter(), _toy_fragment())
+    with pytest.raises(InvariantBreachError) as ei:
+        w.query_stepwise(guard=GuardConfig(policy="halt"))
+    assert ei.value.bundle["verdict"]["kind"] == "active_range"
+
+
+# ---- app invariants vs injected carry corruption -------------------------
+
+
+def _model_apps():
+    from libgrape_lite_tpu.models import BFS, CDLP, SSSP, WCC, PageRank
+
+    return {
+        "sssp": (SSSP, dict(source=6)),
+        "bfs": (BFS, dict(source=6)),
+        "pagerank": (PageRank, dict(delta=0.85, max_round=10)),
+        "wcc": (WCC, {}),
+        "cdlp": (CDLP, dict(max_round=10)),
+    }
+
+
+@pytest.mark.parametrize("app_name", ["sssp", "bfs", "pagerank", "wcc", "cdlp"])
+def test_invariants_catch_corrupt_carry(graph_cache, app_name):
+    """Each model app's declared invariants must detect a corrupt_carry
+    fault within one probe (stepwise probes every round)."""
+    from libgrape_lite_tpu.ft.faults import FaultPlan
+    from libgrape_lite_tpu.guard import InvariantBreachError
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    app_cls, qa = _model_apps()[app_name]
+    w = Worker(app_cls(), graph_cache(2))
+    with pytest.raises(InvariantBreachError) as ei:
+        w.query_stepwise(
+            guard="halt", fault_plan=FaultPlan(corrupt_carry_at=2), **qa
+        )
+    bundle = ei.value.bundle
+    assert bundle["verdict"]["kind"] == "invariant"
+    # the corrupted state is probed the same round it lands
+    assert bundle["round"] == 2
+    assert bundle["verdict"]["failed"]
+
+
+def test_model_apps_declare_invariants(graph_cache):
+    """All six LDBC model apps ship non-default invariants."""
+    from libgrape_lite_tpu.models import BFS, CDLP, LCC, SSSP, WCC, PageRank
+
+    frag = graph_cache(2)
+    expect = {
+        SSSP: {"in_range(dist)", "monotone_non_increasing(dist)"},
+        BFS: {"in_range(depth)", "monotone_non_increasing(depth)"},
+        PageRank: {"finite(rank)", "in_range(rank)", "pagerank_mass"},
+        WCC: {"in_range(comp)", "monotone_non_increasing(comp)"},
+        CDLP: {"cdlp_label_universe"},
+        LCC: {"in_range(lcc)"},
+    }
+    for cls, names in expect.items():
+        app = cls()
+        state = app.init_state(frag, **(
+            {"source": 6} if cls in (SSSP, BFS) else {}
+        ))
+        got = {i.name for i in app.invariants(frag, state)}
+        assert names <= got, f"{cls.__name__}: {got}"
+
+
+# ---- self-heal rollback-replay ------------------------------------------
+
+
+@pytest.mark.parametrize("app_name", ["sssp", "pagerank", "wcc"])
+def test_self_heal_byte_identical(graph_cache, app_name, tmp_path):
+    """The acceptance drill in-process: corrupt_carry@K is detected
+    within one cadence, rolled back to the last good snapshot, replayed
+    (paranoid mode), and the run converges byte-identically to a
+    fault-free run."""
+    from libgrape_lite_tpu.ft.faults import FaultPlan
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    app_cls, qa = _model_apps()[app_name]
+    frag = graph_cache(2)
+
+    ref = Worker(app_cls(), frag)
+    ref.query(**qa)  # fused fault-free reference
+    want = ref.result_values()
+
+    w = Worker(app_cls(), frag)
+    w.query(
+        checkpoint_every=3, checkpoint_dir=str(tmp_path / "ck"),
+        guard="rollback", fault_plan=FaultPlan(corrupt_carry_at=4), **qa,
+    )
+    assert w.result_values().tobytes() == want.tobytes()
+    rep = w.guard_report
+    assert rep["rollbacks"] == 1
+    assert rep["paranoid"]  # replay ran with per-round probes
+    assert len(rep["breaches"]) == 1
+    # detection is same-round: the injection at superstep 4 is probed
+    # before anything else touches the state
+    assert rep["breaches"][0]["round"] == 4
+
+
+def test_rollback_without_checkpoints_halts(graph_cache):
+    from libgrape_lite_tpu.ft.faults import FaultPlan
+    from libgrape_lite_tpu.guard import InvariantBreachError
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    w = Worker(SSSP(), graph_cache(2))
+    with pytest.raises(InvariantBreachError):
+        w.query_stepwise(
+            guard="rollback", fault_plan=FaultPlan(corrupt_carry_at=2),
+            source=6,
+        )
+
+
+def test_deterministic_fault_localized_after_rollback(graph_cache, tmp_path):
+    """A fault that recurs at the same superstep after a rollback is
+    deterministic: the guard must localize it and halt instead of
+    looping rollbacks forever."""
+    from libgrape_lite_tpu.ft.faults import FaultPlan
+    from libgrape_lite_tpu.guard import InvariantBreachError
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    plan = FaultPlan(corrupt_carry_at=2)  # non-noop so the hook is wired
+    w = Worker(SSSP(), graph_cache(2))
+
+    def refire(carry, rounds):
+        # deterministic fault: corrupts EVERY superstep >= 2, so the
+        # paranoid replay reproduces the breach at the same round
+        if rounds < 2:
+            return None
+        plan.corrupt_carry_at = rounds
+        plan._carry_fired = False
+        return FaultPlan.maybe_corrupt_carry(plan, carry, rounds)
+
+    plan.maybe_corrupt_carry = refire
+    with pytest.raises(InvariantBreachError) as ei:
+        w.query(
+            checkpoint_every=2, checkpoint_dir=str(tmp_path / "ck"),
+            guard="rollback", fault_plan=plan, source=6,
+        )
+    assert ei.value.bundle.get("localized_round") == 2
+    assert w.guard_report["rollbacks"] == 1
+
+
+class JumpUp(ParallelAppBase):
+    """Decrements its carry every round, except superstep 4 bumps it to
+    a new, self-sustaining fixed point — a monotonicity violation that
+    settles immediately, so only a probe comparing against the LAST
+    PROBE's carry (not the previous round's) can see it at cadence > 1."""
+
+    max_rounds = 50
+    replicated_keys = frozenset({"step"})
+
+    def init_state(self, frag, **_):
+        return {
+            "v": np.full((frag.fnum, frag.vp), 20.0, np.float64),
+            "step": np.int32(0),
+        }
+
+    def peval(self, ctx, frag, state):
+        return state, jnp.int32(1)
+
+    def inceval(self, ctx, frag, state):
+        step = state["step"] + jnp.int32(1)
+        v = jnp.maximum(state["v"] - 1.0, 0.0)
+        v = jnp.where(step >= jnp.int32(4), jnp.maximum(v, 30.0), v)
+        return {"v": v, "step": step}, jnp.int32(1)
+
+    def invariants(self, frag, state):
+        from libgrape_lite_tpu.guard.invariants import (
+            monotone_non_increasing,
+        )
+
+        return [monotone_non_increasing("v")]
+
+    def finalize(self, frag, state):
+        return np.asarray(state["v"])
+
+
+def test_monotone_checked_across_probe_cadence():
+    """Cadence 3, violation at superstep 4 that becomes a fixed point:
+    round-to-round comparison at the round-6 probe would see nothing
+    (the state stopped changing by then); comparing against the
+    round-3 probe carry catches it."""
+    from libgrape_lite_tpu.guard import GuardConfig, InvariantBreachError
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    w = Worker(JumpUp(), _toy_fragment())
+    with pytest.raises(InvariantBreachError) as ei:
+        w.query_stepwise(guard=GuardConfig(policy="halt", every=3))
+    assert ei.value.bundle["round"] == 6
+
+
+def test_probe_forced_on_checkpoint_rounds(graph_cache, tmp_path):
+    """Guard cadence 3 with checkpoint cadence 2: corruption at
+    superstep 4 (a checkpoint round the guard cadence would skip) must
+    be probed BEFORE the save — otherwise a corrupt snapshot becomes
+    the rollback target and the self-heal misdiagnoses a transient
+    fault as deterministic."""
+    from libgrape_lite_tpu.ft.faults import FaultPlan
+    from libgrape_lite_tpu.guard import GuardConfig
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    ref = Worker(SSSP(), frag)
+    ref.query(source=6)
+    want = ref.result_values()
+
+    w = Worker(SSSP(), frag)
+    w.query(
+        checkpoint_every=2, checkpoint_dir=str(tmp_path / "ck"),
+        guard=GuardConfig(policy="rollback", every=3),
+        fault_plan=FaultPlan(corrupt_carry_at=4), source=6,
+    )
+    assert w.result_values().tobytes() == want.tobytes()
+    rep = w.guard_report
+    assert rep["rollbacks"] == 1
+    assert rep["breaches"][0]["round"] == 4  # probed on the ckpt round
+
+
+def test_stagnation_survives_inf_sentinels():
+    """A +inf sentinel present in both carries (padded rows, unreached
+    SSSP vertices) must not poison the residual with inf-inf=NaN and
+    silently disable the stagnation watchdog."""
+    from libgrape_lite_tpu.guard import DivergenceError, GuardConfig
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    class StagnatorWithInf(Stagnator):
+        def init_state(self, frag, **_):
+            s = Stagnator.init_state(self, frag)
+            s["v"][0, 0] = np.inf
+            return s
+
+    w = Worker(StagnatorWithInf(), _toy_fragment())
+    cfg = GuardConfig(policy="halt", every=1, stagnation_window=6)
+    with pytest.raises(DivergenceError) as ei:
+        w.query_stepwise(guard=cfg)
+    assert ei.value.bundle["verdict"]["kind"] == "stagnation"
+
+
+# ---- guarded-fused path --------------------------------------------------
+
+
+def test_guarded_fused_matches_fused(graph_cache):
+    """Healthy run, guards on: chunked-fused execution returns results
+    byte-identical to the untouched fused path, probing every chunk."""
+    from libgrape_lite_tpu.guard import GuardConfig
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    ref = Worker(SSSP(), frag)
+    ref.query(source=6)
+    want = ref.result_values()
+
+    w = Worker(SSSP(), frag)
+    w.query(source=6, guard=GuardConfig(policy="halt", every=4))
+    assert w.result_values().tobytes() == want.tobytes()
+    assert w.rounds == ref.rounds
+    rep = w.guard_report
+    assert rep["probes"] >= ref.rounds // 4
+    assert not rep["breaches"]
+
+
+def test_guard_env_arms_fused_query(graph_cache, monkeypatch):
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    monkeypatch.setenv("GRAPE_GUARD", "halt")
+    monkeypatch.setenv("GRAPE_GUARD_EVERY", "8")
+    w = Worker(SSSP(), graph_cache(2))
+    w.query(source=6)
+    rep = w.guard_report
+    assert rep is not None and rep["policy"] == "halt" and rep["every"] == 8
+
+
+# ---- guards off: the fused fast path is untouched ------------------------
+
+
+def test_guards_off_never_touch_guard_machinery(graph_cache, monkeypatch):
+    """With guards off (the default), query() must take exactly the
+    fused path: no monitor, no chunk runner, no guard module involvement
+    — the zero-overhead contract."""
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    monkeypatch.delenv("GRAPE_GUARD", raising=False)
+    w = Worker(SSSP(), graph_cache(2))
+
+    def boom(*a, **k):
+        raise AssertionError("guarded path taken with guards off")
+
+    monkeypatch.setattr(w, "_query_guarded", boom)
+    w.query(source=6)
+    assert w.guard_report is None
+    # only the plain fused runner was compiled (no "chunk" keys)
+    assert all(
+        not (isinstance(k, tuple) and k and k[0] == "chunk")
+        for k in w._runner_cache
+    )
+
+
+def test_guards_off_fused_trace_identical(monkeypatch):
+    """The fused runner's lowered HLO must be byte-identical whether or
+    not the guard subsystem is importable/armed-off — guards off is not
+    'low overhead', it is the same program."""
+    import jax
+
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = _toy_fragment()
+
+    def lowered_text():
+        w = Worker(SSSP(), frag)
+        app = w.app
+        state = w._place_state(app.init_state(frag, source=0))
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        carry = {k: v for k, v in state.items() if k not in eph}
+        eph_part = {k: v for k, v in state.items() if k in eph}
+        runner = w._make_runner(0)(state)
+        return jax.jit(runner).lower(frag.dev, carry, eph_part).as_text()
+
+    monkeypatch.delenv("GRAPE_GUARD", raising=False)
+    a = lowered_text()
+    monkeypatch.setenv("GRAPE_GUARD", "off")
+    b = lowered_text()
+    assert a == b
+
+
+# ---- config --------------------------------------------------------------
+
+
+def test_guard_config_validation():
+    from libgrape_lite_tpu.guard import GuardConfig
+
+    with pytest.raises(ValueError, match="policy"):
+        GuardConfig(policy="bogus")
+    with pytest.raises(ValueError, match="cadence"):
+        GuardConfig(policy="warn", every=0)
+    assert not GuardConfig.resolve(None).enabled or True  # env-dependent
+    assert GuardConfig.resolve("halt").policy == "halt"
+    cfg = GuardConfig(policy="rollback", every=3)
+    assert GuardConfig.resolve(cfg) is cfg
+
+
+def test_watchdog_reset_forgets_digests():
+    from libgrape_lite_tpu.guard import DivergenceWatchdog
+
+    wd = DivergenceWatchdog(stagnation_window=4)
+    assert wd.observe(1, (1, 2), None) is None
+    assert wd.observe(2, (3, 4), None) is None
+    v = wd.observe(3, (1, 2), None)
+    assert v and v["kind"] == "oscillation" and v["period"] == 2
+    wd.reset()
+    # a replay re-presenting the same digests must not re-trip
+    assert wd.observe(1, (1, 2), None) is None
