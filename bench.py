@@ -21,6 +21,21 @@ says `_cpu_fallback`.  Env knobs:
   GRAPE_BENCH_NO_PROBE=1       skip the probe and assume DEAD (CPU
                                fallback, XLA only — the safe default
                                for probe-less smoke runs)
+  GRAPE_PACK_SCAN=mxu|shift    pack segmented-scan backend (default
+                               mxu: triangular-matmul prefix on the
+                               matrix unit; shift: the log-stage
+                               ladder, kept for A/B)
+
+BENCH-json ledger fields (r7): `pack_ledger` carries the planner's
+static op budget at bench geometry with SPLIT engine columns —
+`vpu_ops_per_edge` (vector-ALU work), `mxu_elems_per_edge` (matmul
+output elements of the MXU scan), `bytes_per_edge` (every shipped
+stream table at its real narrowed dtype), `gather_slots_per_edge`,
+`per_stage_ops_per_edge` (VPU attribution: overlay/route/flags/scan/
+extract), the modeled MTEPS bracket under `modeled`, and
+`ledger_recount_mismatch` (planner annotations vs the cost model's
+independent recount from the shipped arrays; > 5% on either engine
+column fails the bench after the measurements are printed).
 
 Baseline derivation (BASELINE.md): the reference GPU backend runs
 PageRank on soc-LiveJournal1 (68.99M directed edges) in 24.65 ms on
@@ -375,10 +390,12 @@ def main():
             summ = bench_ledger_summary(SCALE, EDGE_FACTOR,
                                         cache_dir=PLAN_CACHE_DIR)
             record["pack_ledger"] = {
-                "alu_ops_per_edge": summ["alu_ops_per_edge"],
+                "vpu_ops_per_edge": summ["vpu_ops_per_edge"],
+                "mxu_elems_per_edge": summ["mxu_elems_per_edge"],
                 "gather_slots_per_edge": summ["gather_slots_per_edge"],
                 "bytes_per_edge": summ["bytes_per_edge"],
                 "per_stage_ops_per_edge": summ["per_stage_ops_per_edge"],
+                "scan_mode": os.environ.get("GRAPE_PACK_SCAN", "mxu"),
                 "modeled": summ["scenarios"],
                 "ledger_recount_mismatch":
                     summ["ledger_recount_mismatch"],

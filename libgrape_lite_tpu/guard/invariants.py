@@ -105,6 +105,32 @@ def monotone_non_increasing(key: str) -> Invariant:
     )
 
 
+def monotone_non_decreasing(key: str) -> Invariant:
+    """No element may shrink between consecutive probes (peeling-level
+    counters, accumulating sums).  Transitive across cadence > 1 like
+    its mirror; NaN-blind by itself — pair with a range/NaN check."""
+    return _count_invariant(
+        f"monotone_non_decreasing({key})", key,
+        lambda prev, cur: cur[key] < prev[key],
+        f"carry {key!r} may only increase between supersteps",
+    )
+
+
+def set_once(key: str, unset) -> Invariant:
+    """Elements may change only FROM the `unset` sentinel: once a
+    value is pinned it must never change again (core numbers, first
+    -touch labels).  A corrupted pinned element therefore trips on the
+    next probe even when the corruption is in-range."""
+    return _count_invariant(
+        f"set_once({key})", key,
+        lambda prev, cur: jnp.logical_and(
+            cur[key] != prev[key],
+            prev[key] != jnp.asarray(unset, prev[key].dtype),
+        ),
+        f"carry {key!r} may only change from its unset value {unset!r}",
+    )
+
+
 def default_invariants(app, frag, state) -> list:
     """The floor every app gets for free: NaN-free float carries.
     (The active-vote range check `0 <= active <= vnum` is host-side

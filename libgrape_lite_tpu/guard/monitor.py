@@ -117,7 +117,7 @@ class GuardMonitor:
         # `dev` rides as a jit ARGUMENT (DeviceFragment is a pytree):
         # closing over it would bake multi-MB fragment arrays into the
         # probe executable as XLA constants
-        def probe(dev, prev, cur):
+        def inv_part(dev, prev, cur):
             oks, vals = [], []
             for inv in self._invariants:
                 ok, val = inv.check(dev, prev, cur)
@@ -129,6 +129,10 @@ class GuardMonitor:
             vals = (
                 jnp.stack(vals) if vals else jnp.zeros((0,), jnp.float32)
             )
+            return oks, vals
+
+        def probe(dev, prev, cur):
+            oks, vals = inv_part(dev, prev, cur)
             digest = carry_digest(cur)
             residual = None
             if float_keys:
@@ -150,11 +154,24 @@ class GuardMonitor:
             return oks, vals, digest, residual
 
         self._probe = jax.jit(probe)
+        # invariants-only probe for callers that already hold the
+        # digest/residual (the guarded-fused chunk runner emits them
+        # as extra loop outputs); apps with no invariants then skip
+        # the probe dispatch entirely
+        self._probe_inv = jax.jit(inv_part) if kept else None
 
     # ---- per-probe entry point ------------------------------------------
 
     def check(self, prev: Dict, cur: Dict, rounds: int,
-              active: int) -> Optional[Breach]:
+              active: int, *, digest=None,
+              residual=None) -> Optional[Breach]:
+        """One probe.  `digest`/`residual` may be supplied by a caller
+        that computed them inside its own dispatch (the guarded-fused
+        chunk runner emits the carry digest and masked residual as
+        extra loop outputs — value-identical to the probe's, same
+        functions on the same global carry); the monitor then runs
+        only the invariants-only probe, or nothing at all when the app
+        declares no invariants."""
         self.probes += 1
         if self._probe is None:
             self._resolve(cur)
@@ -171,12 +188,19 @@ class GuardMonitor:
             }
             return self._policy(verdict, rounds, active, failed=None)
 
-        oks, vals, digest_words, residual = self._probe(
-            self.frag.dev, prev, cur
-        )
+        if digest is None:
+            oks, vals, digest_words, residual = self._probe(
+                self.frag.dev, prev, cur
+            )
+            digest = tuple(int(x) for x in np.asarray(digest_words))
+            if residual is not None:
+                residual = float(residual)
+        elif self._probe_inv is not None:
+            oks, vals = self._probe_inv(self.frag.dev, prev, cur)
+        else:
+            oks = vals = np.zeros((0,))
         oks = np.asarray(oks)
         vals = np.asarray(vals)
-        digest = tuple(int(x) for x in np.asarray(digest_words))
         self._digest_hist.append((rounds, digest_hex(digest)[:16]))
         self._active_hist.append((rounds, int(active)))
         del self._digest_hist[:-_HISTORY], self._active_hist[:-_HISTORY]

@@ -115,6 +115,20 @@ class BC(ParallelAppBase):
 
         return {"depth": depth, "pn": pn, "delta": delta}, jnp.int32(0)
 
+    def invariants(self, frag, state):
+        # Brandes partials: shortest-path counts and dependencies are
+        # finite and nonnegative (in_range(lo=0) rejects NaN — NaN >= 0
+        # is False); depth is the BFS level or the untouched sentinel
+        from libgrape_lite_tpu.guard.invariants import finite, in_range
+
+        return [
+            finite("pn"),
+            in_range("pn", lo=0),
+            finite("delta"),
+            in_range("delta", lo=0),
+            in_range("depth", lo=0, hi=_SENT),
+        ]
+
     def inceval(self, ctx, frag, state):
         return state, jnp.int32(0)
 

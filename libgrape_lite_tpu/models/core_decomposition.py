@@ -41,6 +41,24 @@ class CoreDecomposition(ParallelAppBase):
         alive = jnp.logical_and(state["alive"], frag.out_degree > 0)
         return dict(state, alive=alive), jnp.int32(1)
 
+    def invariants(self, frag, state):
+        # coreness algebra: core numbers are written exactly once
+        # (0 -> level) and never negative; the peeling level only
+        # advances; dead vertices never resurrect
+        from libgrape_lite_tpu.guard.invariants import (
+            in_range,
+            monotone_non_decreasing,
+            monotone_non_increasing,
+            set_once,
+        )
+
+        return [
+            in_range("core", lo=0),
+            set_once("core", unset=0),
+            monotone_non_decreasing("level"),
+            monotone_non_increasing("alive"),
+        ]
+
     def inceval(self, ctx: StepContext, frag, state):
         core, alive, level = state["core"], state["alive"], state["level"]
         ie = frag.ie

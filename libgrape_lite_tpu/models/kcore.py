@@ -42,6 +42,15 @@ class KCore(ParallelAppBase):
         alive = jnp.logical_and(state["alive"], frag.out_degree >= self.k)
         return {"alive": alive}, jnp.int32(1)
 
+    def invariants(self, frag, state):
+        # peeling only removes: a dead vertex must never resurrect
+        # (monotone across any probe cadence — removal is transitive)
+        from libgrape_lite_tpu.guard.invariants import (
+            monotone_non_increasing,
+        )
+
+        return [monotone_non_increasing("alive")]
+
     def inceval(self, ctx: StepContext, frag, state):
         alive = state["alive"]
         ie = frag.ie

@@ -74,3 +74,86 @@ def use_pallas() -> bool:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:
         return False
+
+
+_CAP_PROBE = r"""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def probe(name, kernel, *shapes):
+    try:
+        args = [jax.ShapeDtypeStruct(s, d) for s, d in shapes]
+        jax.jit(lambda *a: pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(shapes[0][0], shapes[0][1]),
+        )(*a)).trace(*args).lower(lowering_platforms=("tpu",))
+        print(f"CAP {name} ok")
+    except Exception as e:
+        print(f"CAP {name} fail {type(e).__name__}")
+
+def k_sublane_gather(x_ref, i_ref, o_ref):
+    o_ref[...] = jnp.take_along_axis(
+        x_ref[...], i_ref[...].astype(jnp.int32), axis=0
+    )
+
+def k_int_reduce(x_ref, i_ref, o_ref):
+    o_ref[...] = (
+        x_ref[...]
+        + jnp.sum(i_ref[...].astype(jnp.int32), axis=1,
+                  keepdims=True).astype(x_ref.dtype)
+    )
+
+def k_lane_gather(x_ref, i_ref, o_ref):
+    o_ref[...] = jnp.take_along_axis(
+        x_ref[...], i_ref[...].astype(jnp.int32), axis=1
+    )
+
+def k_mxu_dot(x_ref, y_ref, o_ref):
+    o_ref[...] = jnp.dot(x_ref[...], y_ref[...],
+                         preferred_element_type=jnp.float32)
+
+probe("sublane_gather", k_sublane_gather,
+      ((8, 128), jnp.float32), ((8, 128), jnp.int16))
+probe("lane_gather", k_lane_gather,
+      ((8, 128), jnp.float32), ((8, 128), jnp.int8))
+probe("int_reduce", k_int_reduce,
+      ((8, 128), jnp.float32), ((8, 128), jnp.int32))
+probe("mxu_dot", k_mxu_dot,
+      ((128, 128), jnp.float32), ((128, 128), jnp.float32))
+"""
+
+
+@functools.lru_cache(maxsize=None)
+def mosaic_lowering_caps() -> dict:
+    """Probe which Mosaic lowerings THIS jax build supports, offline
+    (client-side `.lower(lowering_platforms=('tpu',))`, no hardware).
+
+    Some jax builds ship a Pallas TPU lowering that refuses primitives
+    real TPU releases handle (the session's build rejects even the
+    shape-matched sublane `take_along_axis` and integer reductions).
+    The offline lowering regressions skip — with the missing capability
+    named — instead of failing on environment, while still failing
+    loudly on a REAL kernel regression when the build can lower.  Runs
+    in a subprocess with the axon plugin disabled (its sitecustomize
+    backend init can hang when the tunnel is down)."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _CAP_PROBE],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+    except Exception:
+        return {}
+    caps = {}
+    for line in r.stdout.splitlines():
+        parts = line.split()
+        if len(parts) >= 3 and parts[0] == "CAP":
+            caps[parts[1]] = parts[2] == "ok"
+    return caps

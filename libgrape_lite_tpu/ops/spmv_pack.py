@@ -15,9 +15,16 @@ sublane gather, or a static 3-stage shuffle (ops/route3.py):
        tiny [HUB/128, 128] register table via lane gathers + selects.
     2. ROUTE    gathered values back to CSR (row-sorted) slot order —
        a static 3-stage shuffle.
-    3. SCAN     segmented inclusive sum over the flattened block in
-       log2(SUB*128) shift-add stages (segment starts are a static
-       flag stream).
+    3. SCAN     segmented sums over the flattened block.  Default
+       (GRAPE_PACK_SCAN=mxu): MXU prefix sums — a [SUB,128] @
+       tri[128,128] triangular matmul per row, a chained per-group
+       inter-row tail prefix, and segment restoration through two
+       static gathers against host-planned start planes (ps/bk) —
+       flat 10 VPU ops/slot with the heavy lifting on the matrix
+       unit.  Fallback (=shift, and always for min/max semirings):
+       ceil(log2(max_seglen)) span-aware shift-add stages against a
+       static segment-start flag stream.  Engagement is per level by
+       modeled cost (see _decide_level_scan).
     4. EXTRACT  each row's last-slot scan value (= the row's partial
        sum within the block) into a compact [OUT_SUB, 128] stream —
        another static shuffle.
@@ -66,6 +73,24 @@ def _compose_enabled() -> bool:
     return os.environ.get("GRAPE_PACK_COMPOSE", "1") not in ("0", "")
 
 
+def _scan_mode() -> str:
+    """Segmented-scan backend: "mxu" (default) restores segment sums
+    from MXU triangular-matmul prefix sums; "shift" is the log-stage
+    shift-add ladder kept as the A/B fallback (GRAPE_PACK_SCAN=shift).
+    Engagement is per LEVEL and only where the modeled VPU cost wins
+    (see _decide_level_scan) — shallow-ladder blocks keep the shift
+    form even in mxu mode, and min/max semirings always run the ladder
+    (a matmul cannot evaluate a tropical prefix)."""
+    import os
+
+    mode = os.environ.get("GRAPE_PACK_SCAN", "mxu")
+    if mode not in ("mxu", "shift"):
+        raise ValueError(
+            f"GRAPE_PACK_SCAN={mode!r}: expected 'mxu' or 'shift'"
+        )
+    return mode
+
+
 def _scan_stages_for(rows_sorted: np.ndarray) -> int:
     """ceil(log2(max segment run)) — the number of shift-combine scan
     stages that provably reach every segment's start.  After S stages
@@ -81,6 +106,77 @@ def _scan_stages_for(rows_sorted: np.ndarray) -> int:
     bounds = np.concatenate([[-1], ch, [e - 1]])
     max_run = int(np.diff(bounds).max())
     return max(0, int(np.ceil(np.log2(max(1, max_run)))))
+
+
+def _mxu_group_rows(sub: int) -> int:
+    """Sublane-group height of the MXU scan's inter-row carry: 128-row
+    groups when they tile `sub` evenly (the [128, 128] matmul operand
+    the MXU is built for), else one group spanning the whole block
+    (tiny test geometries)."""
+    return 128 if sub % 128 == 0 else sub
+
+
+def _mxu_scan_meta(rows_sorted: np.ndarray, sub: int):
+    """Static restoration planes for the MXU segmented scan of one
+    block over CSR-sorted rows (see the mxu branch of _kernel_body for
+    the device-side consumption):
+
+      ps [sub, C] int8: per slot, the lane of its segment's start when
+         the segment starts IN this row (the in-row restore subtracts
+         the exclusive row prefix at that lane); 0 for slots whose
+         segment carried in from an earlier row (exclusive prefix at
+         lane 0 is exactly 0, so they subtract nothing); the slot's OWN
+         lane for invalid slots (self-isolating: rseg degenerates to
+         the slot's raw value, which nothing downstream reads).
+      bk [sub, C] int: per slot, how many rows back its segment
+         started (0 when it starts in-row); the carry restoration
+         subtracts the exact full row-tail prefix W at row `r - bk`
+         (W[r] - W[r] = 0 for in-row segments — no mask plane).
+
+    The ladder-path flag `f0 = (ps == lane) & (bk == 0)` recovers the
+    shift scan's segment-start-or-invalid flag exactly (min/max kinds
+    run the ladder off these same planes), so mxu blocks ship ps/bk
+    INSTEAD of the flag plane."""
+    e = len(rows_sorted)
+    slots = sub * C
+    lane = (np.arange(slots, dtype=np.int64) % C)
+    ps = lane.copy()
+    bk = np.zeros(slots, dtype=np.int64)
+    if e:
+        i = np.arange(e, dtype=np.int64)
+        s = np.ones(e, dtype=bool)
+        s[1:] = rows_sorted[1:] != rows_sorted[:-1]
+        start = np.maximum.accumulate(np.where(s, i, 0))
+        srow, slane = start // C, start % C
+        r = i // C
+        same = srow == r
+        ps[:e] = np.where(same, slane, 0)
+        bk[:e] = np.where(same, 0, r - srow)
+    return ps.reshape(sub, C).astype(np.int8), bk.reshape(sub, C)
+
+
+# Modeled per-slot VPU ops of the MXU scan (matmuls priced in the
+# separate mxu column): exclusive-rowcum subtract, ps gather, in-row
+# subtract, the W group concat + chained base add, SR iota + subtract,
+# W gather, carry subtract, final add.  Flat — the full-prefix carry
+# has no span-dependent ladder.
+_MXU_SCAN_VPU = 10
+# MXU matmul output planes per block: the lane cumsum, the per-group
+# tail broadcasts, and the per-group exclusive tail prefixes.
+_MXU_SCAN_PLANES = 3
+
+
+def _decide_level_scan(blocks) -> bool:
+    """Engage the MXU scan for a level iff GRAPE_PACK_SCAN=mxu and the
+    summed modeled VPU cost across the level's blocks beats the shift
+    ladder's (3 ops per span-aware stage, plus the flag compare the
+    mxu form drops).  Per level, not per block: a level's blocks share
+    stacked streams and one kernel family, so the scan form must be
+    uniform within it."""
+    if _scan_mode() != "mxu" or not blocks:
+        return False
+    shift = sum(3 * b.scan_stages + 1 for b in blocks)
+    return _MXU_SCAN_VPU * len(blocks) < shift
 
 
 def _lane_mix(local: np.ndarray) -> np.ndarray:
@@ -109,7 +205,16 @@ class PackConfig:
     # budget of v5e — see vmem_bytes(); sub=4096 overflows it
     sub: int = 2048        # sublane rows per block (block = sub*128 slots)
     out_sub: int = 512     # sublane rows per compact output block
-    hub: int = 1024        # hub table size (multiple of 128)
+    # hub=4096 (r7, was 1024): the padded-hub-table read costs two
+    # shape-matched gathers REGARDLESS of hub size (the old register
+    # loop scaled with hub//C, which is why 1024 was chosen), and a
+    # 4x hub absorbs enough Kronecker skew to lift gather-block fill
+    # from ~67% to ~87% at bench geometry (1.5 -> 1.15 slots/edge) —
+    # every per-slot stream byte and VPU op scales down with it.
+    # out_sub=1024 was probed and REJECTED: the distinct-rows cap is
+    # not the binding cutter (block counts unchanged) and halving the
+    # fold group_cap balloons the fold hierarchy (26.8 -> 32.9 B/edge).
+    hub: int = 4096        # hub table size (multiple of 128)
 
     def __post_init__(self):
         # sub/hub index streams are int16 and hub rows split into
@@ -122,6 +227,15 @@ class PackConfig:
             raise ValueError(
                 f"hub={self.hub} must be a positive multiple of {C} "
                 "<= 32767"
+            )
+        if self.hub // C > self.sub:
+            # the hub read is two dynamic gathers from a hub table
+            # padded to [sub, C] (Mosaic's sublane gather requires
+            # table shape == index shape); a hub taller than the block
+            # cannot pad down
+            raise ValueError(
+                f"hub={self.hub} needs {self.hub // C} register rows "
+                f"> sub={self.sub}"
             )
         if not (0 < self.out_sub <= self.sub):
             raise ValueError(
@@ -173,18 +287,22 @@ class PackConfig:
         ermid = max(self.sub, o)
         varying = (
             self.sub * C * (1 + 2 + 1)       # l1 i8, s2 i16, l3 i8
-            + self.sub * C * 1               # flags i8
+            # flags i8, or ps i8 + bk priced at its WIDENED i16 form
+            # (deep segments value-widen bk; the estimate must cover
+            # the worst engaged level, not the narrow best case)
+            + self.sub * C * 3
             + ermid * C * (1 + 2)            # el1 i8, es2 i16
-            + o * C * (1 + 1)                # el3 i8, eval i8
+            + o * C * 1                      # el3 i8
             + o * C * 4                      # out f32
         )
         if has_gather:
-            varying += self.sub * C * (2 + 2)  # sub_idx i16, hub_sel i16
+            varying += self.sub * C * 2        # gidx i16
             if has_w:
                 varying += self.sub * C * 4    # w f32
         else:
             varying += self.sub * C * 4        # fold input vals f32
-        invariant = (self.sub * C + self.hub) * 4 if has_gather else 0
+        # x-table + hub table padded to [sub, C] (shape-matched gather)
+        invariant = 2 * self.sub * C * 4 if has_gather else 0
         temps = (self.sub * C * 4) * 3 + ermid * C * 4
         return 2 * varying + invariant + temps
 
@@ -216,6 +334,11 @@ class BlockPlan:
     # span-aware scan: stages the kernel unrolls for this block
     # (= ceil(log2(max segment run)); further stages are exact no-ops)
     scan_stages: int = 0
+    # MXU scan restoration planes (see _mxu_scan_meta); ps/bk ship in
+    # place of `flags` when the level engages the mxu scan
+    scan_mxu: bool = False
+    ps: Optional[np.ndarray] = None   # [sub, C] int8 in-row start lane
+    bk: Optional[np.ndarray] = None   # [sub, C] int row backspan
     # composed merge route: [sub, C] int source-row plane (one sublane
     # gather) replacing the generic 3-stage `route` on fold levels whose
     # upstream extractions were rewritten to land lane-aligned
@@ -243,43 +366,92 @@ class LevelPlan:
 
 def _block_op_ledger(cfg: PackConfig, *, gather: bool, scan_stages: int,
                      route_moves: int, out_sub: int = 0,
-                     n_tiles: int = 0, tile_sub: int = 0) -> dict:
-    """Exact vector-ALU op counts for one block, by stage.  Counting
+                     n_tiles: int = 0, tile_sub: int = 0,
+                     scan_mxu: bool = False) -> dict:
+    """Exact per-engine op counts for one block, by stage.  Counting
     conventions (shared with scripts/pack_cost_model.py, which verifies
     them independently from the shipped stream arrays):
 
-      * one op = one full-width vector operation over the operand's
-        [rows, 128] plane, priced `rows * 128` lanes;
-      * gather overlay: the 2 hub select/compare passes (the register
-        -table loop's per-slot cost; the x-table sublane dynamic_gather
-        itself is priced separately as `gather_rows` — its rate is the
-        hardware unknown the probe measures);
+      * one VPU op = one full-width vector operation over the
+        operand's [rows, 128] plane, priced `rows * 128` lanes; the
+        per-stage entries below are all VPU ops;
+      * one MXU elem (`mxu` entry) = one element of a triangular /
+        broadcast matmul OUTPUT plane ([B,128] @ [128,128], the one
+        cumsum form Mosaic lowers — priced at the measured 0.008
+        cyc/elem for B >= 512 in scripts/pack_cost_model.py);
+      * gather overlay: 3 ops — the per-row hub-group lane reduce and
+        the two shape-matched hub-table gathers (the x-table sublane
+        dynamic_gather itself is priced separately as `gather_rows` —
+        its rate is the hardware unknown the probe measures).  The
+        merged gidx plane's hub decode and the final select ride
+        inside this price, as the r6 register-loop selects did;
       * route: one op per take_along_axis stage, priced at that
         stage's operand height (generic Route3: l1/s2 at r_mid, l3 at
         r_dst; composed lane-aligned form: one sublane gather at sub);
-      * flags: the one segment-flag compare (`flags != 1`);
-      * scan: 3 ops (shift, select, combine) per unrolled stage;
-      * extract: the eroute stages + the out-validity select, or the
-        per-row-range tile routes on final blocks;
+      * flags: the one segment-flag compare (`flags != 1`) — shift
+        levels only; mxu levels ship ps/bk restoration planes and run
+        no flag pass in the sum semiring (min/max fall back to the
+        ladder and pay a 3-op flag derivation NOT priced here: the
+        ledger prices the sum pipeline the bench runs);
+      * scan: shift levels: 3 ops (shift, select, combine) per
+        span-aware unrolled stage; mxu levels: a FLAT `_MXU_SCAN_VPU`
+        (= 10) restoration ops per slot — the full-prefix inter-row
+        carry has no span-dependent ladder — with the matmuls landing
+        in the `mxu` column as `_MXU_SCAN_PLANES` (= 3) output planes;
+      * extract: the eroute stages (the out-validity select is gone:
+        unrouted compact slots carry garbage that is its own flagged
+        segment downstream, the same isolation proof that removed the
+        scan's validity select in r6), or the per-row-range tile
+        routes on final blocks (whose validity select SURVIVES — tile
+        outputs are summed straight into the dense result);
       * fold-input assembly (concat / disjoint-slot merge) runs in XLA
         outside the kernels and is excluded, as it always was.
     """
     slots = cfg.sub * C
     led = {
-        "overlay": 2 * slots if gather else 0,
+        "overlay": 3 * slots if gather else 0,
         "route": route_moves * slots,
-        "flags": slots,
-        "scan": 3 * scan_stages * slots,
+        "flags": 0 if scan_mxu else slots,
+        "scan": (_MXU_SCAN_VPU if scan_mxu
+                 else 3 * scan_stages) * slots,
+        "mxu": _MXU_SCAN_PLANES * slots if scan_mxu else 0,
     }
     if n_tiles:
         led["extract"] = n_tiles * (2 * slots + 2 * tile_sub * C)
     elif out_sub:
         r_mid = max(cfg.sub, out_sub)
-        led["extract"] = 2 * r_mid * C + 2 * out_sub * C
+        led["extract"] = 2 * r_mid * C + out_sub * C
     else:
         led["extract"] = 0
     led["gather_rows"] = slots if gather else 0
     return led
+
+
+def _reledger_block(cfg: PackConfig, blk: "BlockPlan") -> dict:
+    """Recompute a block's ledger from its own planned structure —
+    used when a post-pass changes scan parameters (level-wide mxu
+    engagement, multi-shard stage unification)."""
+    return _block_op_ledger(
+        cfg,
+        gather=blk.sub_idx is not None,
+        scan_stages=blk.scan_stages,
+        route_moves=1 if blk.route_rows is not None else 3,
+        out_sub=(blk.eroute.l3.shape[0] if blk.eroute is not None
+                 else 0),
+        n_tiles=len(blk.tiles) if blk.tiles is not None else 0,
+        tile_sub=(blk.tiles[0][1].shape[0] // C
+                  if blk.tiles else 0),
+        scan_mxu=blk.scan_mxu,
+    )
+
+
+def _apply_level_scan_mode(cfg: PackConfig, blocks) -> None:
+    """Set the level-uniform scan form on `blocks` (mxu iff modeled
+    cheaper under GRAPE_PACK_SCAN=mxu) and refresh their ledgers."""
+    mxu = _decide_level_scan(blocks)
+    for b in blocks:
+        b.scan_mxu = mxu
+        b.ledger = _reledger_block(cfg, b)
 
 
 def _ledger_of_levels(shard_levels, n_cols: int, cfg: PackConfig) -> dict:
@@ -292,13 +464,14 @@ def _ledger_of_levels(shard_levels, n_cols: int, cfg: PackConfig) -> dict:
     level — the same accounting the r4 cost model used."""
     n_lv = len(shard_levels[0])
     out_levels = []
-    totals = {"alu_ops": 0, "gather_rows": 0, "hbm_bytes": 0,
-              "blocks": 0}
+    totals = {"vpu_ops": 0, "mxu_ops": 0, "gather_rows": 0,
+              "hbm_bytes": 0, "blocks": 0}
     per_stage_tot: dict = {}
     edges = 0
     for li in range(n_lv):
         per_stage: dict = {}
         gr = 0
+        mxu = 0
         hbm = 0
         nbl = 0
         has_gather = shard_levels[0][li].has_gather
@@ -309,6 +482,8 @@ def _ledger_of_levels(shard_levels, n_cols: int, cfg: PackConfig) -> dict:
                 for k, v in b.ledger.items():
                     if k == "gather_rows":
                         gr += int(v)
+                    elif k == "mxu":
+                        mxu += int(v)
                     else:
                         per_stage[k] = per_stage.get(k, 0) + int(v)
                 if lv.has_gather:
@@ -320,13 +495,14 @@ def _ledger_of_levels(shard_levels, n_cols: int, cfg: PackConfig) -> dict:
                 )
             if lv.has_gather:
                 hbm += min(n_cols, cfg.slots * len(lv.blocks)) * 4
-        alu = sum(per_stage.values())
+        vpu = sum(per_stage.values())
         out_levels.append({
             "level": li, "blocks": nbl, "has_gather": bool(has_gather),
-            "alu_ops": alu, "gather_rows": gr, "hbm_bytes": hbm,
-            "per_stage": per_stage,
+            "vpu_ops": vpu, "mxu_ops": mxu, "gather_rows": gr,
+            "hbm_bytes": hbm, "per_stage": per_stage,
         })
-        totals["alu_ops"] += alu
+        totals["vpu_ops"] += vpu
+        totals["mxu_ops"] += mxu
         totals["gather_rows"] += gr
         totals["hbm_bytes"] += hbm
         totals["blocks"] += nbl
@@ -375,14 +551,27 @@ class PackPlan:
 # --------------------------------------------------------------------------
 
 
+def _hub_row_margin(cfg: PackConfig) -> int:
+    """Slot capacity reserved for the row-aligned hub assignment: hub
+    edges are placed group-sorted with each kernel row taking entries
+    of a SINGLE 128-entry hub group (the lane-uniform row index the
+    two-gather hub read requires — see _plan_gather_block); a group
+    change mid-row skips the row's remaining holes, wasting at most
+    (groups - 1) * (C - 1) slots per block.  hub // C <= sub by
+    PackConfig validation, so the margin always leaves >= sub slots."""
+    return (cfg.hub // C) * (C - 1)
+
+
 def _cut_blocks(rows, local_cols, hub_mask, cfg: PackConfig):
     """Split CSR-ordered edges into block ranges such that per block:
-    no mixed lane exceeds `sub` non-hub edges, slots <= sub*128, and
+    no mixed lane exceeds `sub` non-hub edges, slots (plus the hub
+    row-alignment margin when hub edges are present) <= sub*128, and
     distinct rows <= max_distinct.  Returns list of (lo, hi).
 
     O(E): per-lane edge position lists + segment-start prefix counts
     give each cut point in O(1)."""
     e = len(rows)
+    cap = cfg.slots - (_hub_row_margin(cfg) if hub_mask.any() else 0)
     lane = np.where(hub_mask, -1, _lane_mix(local_cols))
     # per-lane position lists: pos_by_lane[l] = sorted edge indices in l
     order = np.argsort(lane, kind="stable")
@@ -398,7 +587,7 @@ def _cut_blocks(rows, local_cols, hub_mask, cfg: PackConfig):
     cuts = []
     lo = 0
     while lo < e:
-        hi = min(e, lo + cfg.slots)
+        hi = min(e, lo + cap)
         # lane overflow: for each lane, the (rank_at_lo + sub)-th edge
         # of that lane is the first infeasible position
         for l in range(C):
@@ -442,13 +631,48 @@ def _plan_gather_block(rows, cols, hub_idx, base, cfg: PackConfig,
     )
     slot[nh[order]] = pos_in_lane * C + lane_sorted
     assert (pos_in_lane < sub).all(), "lane overflow despite block cut"
-    # hub edges take remaining slots (any lane)
+    # hub edges take remaining slots (any lane), GROUP-SORTED and
+    # row-aligned: every kernel row's hub slots read entries of one
+    # 128-entry hub group, so the kernel's hub-table row index is
+    # lane-uniform per row and the two shape-matched gathers compose
+    # correctly (a per-slot row plane would read the row index at the
+    # POST-lane-gather position — wrong whenever rows mix groups).  A
+    # group change mid-row skips the row's remaining holes; the block
+    # cutter reserved capacity for exactly that (_hub_row_margin).
     hub_e = np.nonzero(is_hub)[0]
     if len(hub_e):
         used = np.zeros(sub * C, dtype=bool)
         used[slot[nh]] = True
         free = np.nonzero(~used)[0]
-        slot[hub_e] = free[: len(hub_e)]
+        order_h = np.argsort(hub_idx[hub_e] >> 7, kind="stable")
+        hub_sorted = hub_e[order_h]
+        grp = hub_idx[hub_sorted] >> 7
+        bounds = np.concatenate(
+            [[0], np.nonzero(np.diff(grp))[0] + 1, [len(grp)]]
+        )
+        fi = 0
+        for gi in range(len(bounds) - 1):
+            k, k2 = int(bounds[gi]), int(bounds[gi + 1])
+            take = k2 - k
+            assert fi + take <= len(free), \
+                "hub row-alignment margin exhausted despite block cut"
+            slot[hub_sorted[k:k2]] = free[fi:fi + take]
+            fi += take
+            # a group must not share a row with the next: skip the
+            # last used row's remaining holes
+            if fi and fi < len(free):
+                last_row = free[fi - 1] // C
+                while fi < len(free) and free[fi] // C == last_row:
+                    fi += 1
+        # the invariant the kernel's lane-uniform row index relies on
+        hrows = slot[hub_sorted] // C
+        gmin = np.full(sub, np.iinfo(np.int64).max)
+        gmax = np.full(sub, -1, dtype=np.int64)
+        np.minimum.at(gmin, hrows, grp)
+        np.maximum.at(gmax, hrows, grp)
+        occ = gmax >= 0
+        assert (gmax[occ] == gmin[occ]).all(), \
+            "a kernel row mixes hub groups"
     assert (slot >= 0).all()
 
     # ---- gather streams ----
@@ -490,10 +714,12 @@ def _plan_gather_block(rows, cols, hub_idx, base, cfg: PackConfig,
         w_block[csr_r, csr_l] = w.astype(np.float32)
 
     stages = _scan_stages_for(rows)
+    ps, bk = _mxu_scan_meta(rows, sub)
     return BlockPlan(
         sub_idx=sub_idx, hub_sel=hub_sel, route=route, flags=flags,
         eroute=eroute, out_rows=out_rows, out_valid=out_valid, n_edges=e,
         w=w_block, scan_stages=stages, e_src=src,
+        ps=ps, bk=bk,
         ledger=_block_op_ledger(cfg, gather=True, scan_stages=stages,
                                 route_moves=3, out_sub=cfg.out_sub),
     )
@@ -622,6 +848,7 @@ def _plan_fold_block(grp, cfg: PackConfig, out_sub: int,
     seg_start[1:] = rows_sorted[1:] != rows_sorted[:-1]
     flags[csr_r, csr_l] = 1 | (seg_start.astype(np.int8) << 1)
     stages = _scan_stages_for(rows_sorted)
+    ps, bk = _mxu_scan_meta(rows_sorted, sub)
 
     last = np.ones(e, dtype=bool)
     last[:-1] = rows_sorted[1:] != rows_sorted[:-1]
@@ -649,7 +876,7 @@ def _plan_fold_block(grp, cfg: PackConfig, out_sub: int,
             sub_idx=None, hub_sel=None, route=route, flags=flags,
             eroute=None, out_rows=out_rows, out_valid=out_valid,
             n_edges=e, tiles=tiles, scan_stages=stages,
-            route_rows=route_rows,
+            route_rows=route_rows, ps=ps, bk=bk,
             ledger=_block_op_ledger(cfg, gather=False, scan_stages=stages,
                                     route_moves=route_moves,
                                     n_tiles=n_tiles, tile_sub=tile_sub),
@@ -665,6 +892,7 @@ def _plan_fold_block(grp, cfg: PackConfig, out_sub: int,
         sub_idx=None, hub_sel=None, route=route, flags=flags,
         eroute=eroute, out_rows=out_rows, out_valid=out_valid, n_edges=e,
         scan_stages=stages, route_rows=route_rows, e_src=src,
+        ps=ps, bk=bk,
         ledger=_block_op_ledger(cfg, gather=False, scan_stages=stages,
                                 route_moves=route_moves, out_sub=out_sub),
     )
@@ -784,8 +1012,15 @@ def _plan_mid_folds(streams, cfg: PackConfig):
                 slots += len(r)
                 i += 1
             grps.append(grp)
-        if len(grps) >= len(streams):
-            break  # no contraction possible; hand over to the final level
+        if 2 * len(grps) > len(streams):
+            # weak contraction (< 2x — overlapping row ranges hit the
+            # distinct-rows cap): a further fold level would ship a
+            # full set of merge/extraction streams for almost no
+            # reduction, while the final level absorbs the same
+            # streams at the same block count (r7: the bench chain
+            # spent two levels shrinking 50 -> 34 -> 33 blocks, ~3.3
+            # HBM B/edge for nothing) — hand over to the final level
+            break
         # route composition engages per level (kernel structure must be
         # uniform across a level's blocks)
         preps = [_group_prep(g) for g in grps]
@@ -893,6 +1128,8 @@ def plan_pack(edge_row: np.ndarray, edge_col: np.ndarray, vp: int,
     fold_levels, streams = _plan_mid_folds(streams, cfg)
     plan.levels += fold_levels
     plan.final = _plan_final_level(streams, vp, cfg)
+    for lv in list(plan.levels) + [plan.final]:
+        _apply_level_scan_mode(cfg, lv.blocks)
     _warn_vmem(cfg, has_w=edge_w is not None,
                final_out_sub=plan.final.tile_sub)
     return plan
@@ -977,6 +1214,75 @@ def _scan_np(v, f, kind, stages: int | None = None):
     return vf.reshape(sub, C)
 
 
+def _scan_np_mxu(v, ps, bk):
+    """Numpy mirror of the kernel's MXU segmented scan (sum semiring
+    only — min/max cannot ride a matmul prefix and fall back to the
+    shift ladder with flags derived from ps/bk).  Stage for stage:
+
+      1. per-row inclusive lane cumsum `rowcum = v @ tri` (the ONE
+         cumsum form that lowers in Pallas TPU; exclusive form by
+         subtracting v), then the in-row restore subtracts the
+         exclusive prefix at each slot's static start lane `ps` —
+         exactly 0 for slots whose segment carried in from an earlier
+         row (ps = 0 → exclusive prefix at lane 0) — giving `rseg`,
+         each slot's sum back to its segment start within the row;
+      2. the FULL exclusive row prefix W of the per-row trailing
+         -segment totals (`tail = rseg @ E127`, a lane-127 broadcast
+         matmul): per 128-row sublane group, `Lexc @ tail` on the MXU
+         plus a [1, C] running base chained across groups — full
+         prefixes NEST, so W[r] - W[r'] is the exact tail sum over
+         rows [r', r) with no span-dependent ladder;
+      3. restoration: every slot adds `W[r] - W[r - bk]` — the
+         carried-in part of its segment (bk = 0 slots subtract W at
+         their own row and add exactly 0, so no mask plane exists).
+
+    NOT bit-identical to the shift ladder on arbitrary floats (a
+    prefix difference rounds differently from a direct tree sum —
+    both are valid f32 segment sums); identical on integer-valued
+    data below the mantissa (any summation order is exact), which is
+    what the parity pin in tests/test_pack_budget.py uses.
+
+    NON-FINITE CAVEAT: prefix differences propagate non-finite values
+    ACROSS segments — one +/-inf or NaN element poisons every later
+    segment of its block with NaN (inf - inf), where the ladder
+    isolates it to its own segment.  Sum-kind callers with possibly
+    non-finite inputs must use GRAPE_PACK_SCAN=shift; the min/max
+    tropical kinds (the ones that legitimately carry inf sentinels —
+    SSSP/BFS/WCC) always run the ladder and are unaffected.  Pinned
+    by tests/test_pack_budget.py::test_mxu_nonfinite_caveat."""
+    sub = v.shape[0]
+    dt = v.dtype
+    tri = np.triu(np.ones((C, C), dtype=dt))
+    rowcum = v @ tri
+    rowcum_exc = rowcum - v
+    sub1 = np.take_along_axis(rowcum_exc, ps.astype(np.int64), axis=1)
+    rseg = rowcum - sub1
+    gr = _mxu_group_rows(sub)
+    e_last = np.zeros((C, C), dtype=dt)
+    e_last[C - 1, :] = 1
+    lexc = np.tril(np.ones((gr, gr), dtype=dt), -1)
+    w = np.empty_like(v)
+    base = np.zeros((1, v.shape[1]), dtype=dt)
+    for g in range(sub // gr):
+        sl = slice(g * gr, (g + 1) * gr)
+        tail_g = rseg[sl] @ e_last
+        s_exc_g = lexc @ tail_g
+        w[sl] = s_exc_g + base
+        base = base + (s_exc_g[gr - 1:gr] + tail_g[gr - 1:gr])
+    srrow = np.arange(sub, dtype=np.int64)[:, None] - bk.astype(np.int64)
+    return rseg + (w - np.take_along_axis(w, srrow, axis=0))
+
+
+def _mxu_f0_np(ps, bk):
+    """The shift ladder's segment-start-or-invalid flag, recovered
+    from the mxu planes (min/max kinds on an mxu level): a slot is a
+    start iff its in-row restore points at itself with no row carry;
+    invalid slots encode ps = own lane, bk = 0 — also starts."""
+    lane = np.arange(C, dtype=np.int64)[None, :]
+    return ((ps.astype(np.int64) == lane)
+            & (bk.astype(np.int64) == 0)).astype(np.float64)
+
+
 def _exec_block_np(plan: PackPlan, lv: LevelPlan, blk: BlockPlan, x,
                    x_hub, in_vals, kind="sum"):
     from libgrape_lite_tpu.ops.route3 import apply_route3_np
@@ -1016,8 +1322,12 @@ def _exec_block_np(plan: PackPlan, lv: LevelPlan, blk: BlockPlan, x,
         routed = apply_route3_np(vals.astype(np.float64), blk.route)
     if lv.has_gather and blk.w is not None:
         routed = wop(routed, blk.w.astype(np.float64))
-    f0 = (blk.flags != 1).astype(np.float64)
-    cs = _scan_np(routed, f0, kind, blk.scan_stages)
+    if blk.scan_mxu and kind == "sum":
+        cs = _scan_np_mxu(routed, blk.ps, blk.bk)
+    else:
+        f0 = (_mxu_f0_np(blk.ps, blk.bk) if blk.scan_mxu
+              else (blk.flags != 1).astype(np.float64))
+        cs = _scan_np(routed, f0, kind, blk.scan_stages)
     if blk.tiles is not None:
         # final block: per-row-range extraction tiles concatenate into
         # the dense [vp] layout
@@ -1085,9 +1395,10 @@ def exec_plan_np(plan: PackPlan, x: np.ndarray, kind="sum") -> np.ndarray:
 # --------------------------------------------------------------------------
 
 
-def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
+def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int,
                  n_stages: int, kind: str = "sum", has_w: bool = False,
-                 extract: bool = True, aligned: bool = False):
+                 extract: bool = True, aligned: bool = False,
+                 scan_mxu: bool = False):
     """Build the kernel function for one scan group (shapes static).
 
     `n_stages` is the group's span-aware scan unroll — blocks are
@@ -1095,11 +1406,17 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
     degree-1 tail block runs 0 shift-combine stages while a hub-heavy
     block runs the full ladder.  `aligned` selects the composed fold
     path: the merge route arrives as ONE sublane-gather plane (rr)
-    instead of a 3-stage Route3."""
+    instead of a 3-stage Route3.  `scan_mxu` selects the MXU scan
+    level form: the segment restoration planes (ps, bk) arrive in
+    place of the flag plane; the sum semiring rides the triangular
+    -matmul prefix (see _scan_np_mxu for the math), min/max run the
+    shift ladder with the flag derived as `(ps == lane) & (bk == 0)`
+    (a matmul cannot evaluate a tropical prefix)."""
     import jax
     import jax.numpy as jnp
 
     op, ident, wop = _jnp_kind(kind)
+    use_mxu = scan_mxu and kind == "sum"
 
     def scan_segmented(v, f):
         s = 1
@@ -1130,15 +1447,46 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
             s *= 2
         return v
 
+    def scan_mxu_sum(v, ps, bk):
+        """Segment sums from MXU prefix sums (see _scan_np_mxu)."""
+        tri = (jax.lax.broadcasted_iota(jnp.int32, (C, C), 0)
+               <= jax.lax.broadcasted_iota(jnp.int32, (C, C), 1)
+               ).astype(v.dtype)
+        rowcum = jnp.dot(v, tri, preferred_element_type=v.dtype)
+        rowcum_exc = rowcum - v
+        sub1 = jnp.take_along_axis(rowcum_exc, ps, axis=1)
+        rseg = rowcum - sub1
+        gr = _mxu_group_rows(sub)
+        e_last = (jax.lax.broadcasted_iota(jnp.int32, (C, C), 0)
+                  == (C - 1)).astype(v.dtype)
+        lexc = (jax.lax.broadcasted_iota(jnp.int32, (gr, gr), 1)
+                < jax.lax.broadcasted_iota(jnp.int32, (gr, gr), 0)
+                ).astype(v.dtype)
+        parts = []
+        base = jnp.zeros((1, C), v.dtype)
+        for g in range(sub // gr):
+            rg = rseg[g * gr:(g + 1) * gr]
+            tail_g = jnp.dot(rg, e_last,
+                             preferred_element_type=v.dtype)
+            s_exc_g = jnp.dot(lexc, tail_g,
+                              preferred_element_type=v.dtype)
+            parts.append(s_exc_g + base)
+            base = base + (s_exc_g[gr - 1:gr] + tail_g[gr - 1:gr])
+        w_pref = (jnp.concatenate(parts, axis=0) if len(parts) > 1
+                  else parts[0])
+        row = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 0)
+        g_w = jnp.take_along_axis(w_pref, row - bk, axis=0)
+        return rseg + (w_pref - g_w)
+
     from libgrape_lite_tpu.ops.route3 import apply_route3
 
-    def scan_part(vals, w_ref, route_refs, flags_ref):
+    def scan_part(vals, w_ref, route_refs, scan_refs):
         """Shared route -> segmented scan.  Values at invalid slots are
         left unmasked: every invalid slot is its own flagged segment
-        (flags==0 -> f0=1), so garbage there can neither combine into a
-        real segment nor be extracted — the old per-slot validity
-        select was a no-op on every observable output."""
-        flags = flags_ref[0].astype(jnp.int32)
+        (flags==0 -> f0=1; mxu planes encode ps=lane, bk=0 -> same),
+        so garbage there can neither combine into a real segment nor
+        be extracted — the old per-slot validity select was a no-op on
+        every observable output."""
         if aligned:
             (rr_ref,) = route_refs
             routed = jnp.take_along_axis(
@@ -1149,83 +1497,103 @@ def _kernel_body(lv_has_gather: bool, sub: int, out_sub: int, hub: int,
             routed = apply_route3(vals, l1_ref[0], s2_ref[0], l3_ref[0])
         if w_ref is not None:
             routed = wop(routed, w_ref[0])
-        f0 = (flags != 1).astype(vals.dtype)
+        if scan_mxu:
+            ps_ref, bk_ref = scan_refs
+            ps = ps_ref[0].astype(jnp.int32)
+            bk = bk_ref[0].astype(jnp.int32)
+            if use_mxu:
+                return scan_mxu_sum(routed, ps, bk)
+            lane = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 1)
+            f0 = jnp.logical_and(ps == lane, bk == 0)
+            return scan_segmented(routed, f0.astype(routed.dtype))
+        (flags_ref,) = scan_refs
+        f0 = (flags_ref[0].astype(jnp.int32) != 1).astype(vals.dtype)
         return scan_segmented(routed, f0)
 
-    def tail(vals, w_ref, route_refs, flags_ref,
-             el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
-        """Shared route -> segmented scan -> extraction epilogue."""
-        cs = scan_part(vals, w_ref, route_refs, flags_ref)
-        ex = apply_route3(cs, el1_ref[0], es2_ref[0], el3_ref[0])
-        out_ref[0] = jnp.where(eval_ref[0] > 0, ex,
-                               jnp.full_like(ex, ident))
+    def tail(vals, w_ref, route_refs, scan_refs,
+             el1_ref, es2_ref, el3_ref, out_ref):
+        """Shared route -> segmented scan -> extraction epilogue.
+        No out-validity select: unrouted compact slots carry garbage
+        that stays its own flagged segment downstream."""
+        cs = scan_part(vals, w_ref, route_refs, scan_refs)
+        out_ref[0] = apply_route3(cs, el1_ref[0], es2_ref[0],
+                                  el3_ref[0])
 
-    def _gather_kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
-                       w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
-                       el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
-            tab = tab_ref[...]
-            # undo the lane mix: tab_mixed[r, l] = tab[r, l ^ mix(r)]
-            rr = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 0)
-            ll = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 1)
-            tab = jnp.take_along_axis(tab, ll ^ _row_mix(rr), axis=1)
-            v_tab = jnp.take_along_axis(
-                tab, sub_idx_ref[0].astype(jnp.int32), axis=0
-            )
-            hs = hub_sel_ref[0].astype(jnp.int32)
-            hs_c = jnp.maximum(hs, 0)
-            hub_hi = hs_c >> 7
-            hub_lo = hs_c & (C - 1)
-            v_hub = jnp.zeros((sub, C), tab.dtype)
-            for k in range(hub // C):
-                tk = jnp.broadcast_to(hubtab_ref[k:k + 1], (sub, C))
-                gk = jnp.take_along_axis(tk, hub_lo, axis=1)
-                v_hub = jnp.where(hub_hi == k, gk, v_hub)
-            vals = jnp.where(hs >= 0, v_hub, v_tab)
-            tail(vals, w_ref, (l1_ref, s2_ref, l3_ref), flags_ref,
-                 el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
+    def gather_vals(tab_ref, hubtab_ref, gidx_ref):
+        tab = tab_ref[...]
+        # undo the lane mix: tab_mixed[r, l] = tab[r, l ^ mix(r)]
+        rr = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 0)
+        ll = jax.lax.broadcasted_iota(jnp.int32, (sub, C), 1)
+        tab = jnp.take_along_axis(tab, ll ^ _row_mix(rr), axis=1)
+        idx = gidx_ref[0].astype(jnp.int32)
+        v_tab = jnp.take_along_axis(tab, jnp.maximum(idx, 0), axis=0)
+        # hub slots encode -1 - hub_idx; the hub table is padded to
+        # [sub, C] so its read is two shape-matched dynamic gathers
+        # instead of a hub//C register loop.  The sublane gather's row
+        # index MUST be lane-uniform (the subsequent lane gather would
+        # otherwise read the row plane at post-permutation positions);
+        # the planner guarantees each kernel row holds hub entries of
+        # ONE 128-entry group, recovered here with a lane-wise max
+        # (non-hub slots carry hs < 0 and never win; all-non-hub rows
+        # read group 0 garbage that the final select discards).
+        hs = -1 - idx
+        hs_c = jnp.maximum(hs, 0)
+        grp_row = jnp.max(hs, axis=1, keepdims=True)
+        rowp = jnp.broadcast_to(
+            jnp.maximum(grp_row, 0) >> 7, (sub, C)
+        )
+        ht = jnp.take_along_axis(hubtab_ref[...], rowp, axis=0)
+        v_hub = jnp.take_along_axis(ht, hs_c & (C - 1), axis=1)
+        return jnp.where(hs >= 0, v_hub, v_tab)
 
     if not extract:
         # final-level phase A: fold-scan only; phase B extracts per
         # row-range tile from the scanned plane
         if aligned:
-            def kernel(vals_ref, rr_ref, flags_ref, out_ref):
+            def kernel(vals_ref, rr_ref, *scan_refs):
+                out_ref = scan_refs[-1]
                 out_ref[0] = scan_part(vals_ref[0], None, (rr_ref,),
-                                       flags_ref)
+                                       scan_refs[:-1])
         else:
-            def kernel(vals_ref, l1_ref, s2_ref, l3_ref, flags_ref,
-                       out_ref):
+            def kernel(vals_ref, l1_ref, s2_ref, l3_ref, *scan_refs):
+                out_ref = scan_refs[-1]
                 out_ref[0] = scan_part(vals_ref[0], None,
                                        (l1_ref, s2_ref, l3_ref),
-                                       flags_ref)
+                                       scan_refs[:-1])
 
         return kernel
 
     if lv_has_gather and has_w:
-        def kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
-                   w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
-                   el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
-            _gather_kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
-                           w_ref, l1_ref, s2_ref, l3_ref, flags_ref,
-                           el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
+        def kernel(tab_ref, hubtab_ref, gidx_ref, w_ref, *rest):
+            route_refs, scan_refs, ext = _split_refs(rest, aligned,
+                                                     scan_mxu)
+            tail(gather_vals(tab_ref, hubtab_ref, gidx_ref), w_ref,
+                 route_refs, scan_refs, *ext)
     elif lv_has_gather:
-        def kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
-                   l1_ref, s2_ref, l3_ref, flags_ref,
-                   el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
-            _gather_kernel(tab_ref, hubtab_ref, sub_idx_ref, hub_sel_ref,
-                           None, l1_ref, s2_ref, l3_ref, flags_ref,
-                           el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
-    elif aligned:
-        def kernel(vals_ref, rr_ref, flags_ref,
-                   el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
-            tail(vals_ref[0], None, (rr_ref,), flags_ref,
-                 el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
+        def kernel(tab_ref, hubtab_ref, gidx_ref, *rest):
+            route_refs, scan_refs, ext = _split_refs(rest, aligned,
+                                                     scan_mxu)
+            tail(gather_vals(tab_ref, hubtab_ref, gidx_ref), None,
+                 route_refs, scan_refs, *ext)
     else:
-        def kernel(vals_ref, l1_ref, s2_ref, l3_ref, flags_ref,
-                   el1_ref, es2_ref, el3_ref, eval_ref, out_ref):
-            tail(vals_ref[0], None, (l1_ref, s2_ref, l3_ref), flags_ref,
-                 el1_ref, es2_ref, el3_ref, eval_ref, out_ref)
+        def kernel(vals_ref, *rest):
+            route_refs, scan_refs, ext = _split_refs(rest, aligned,
+                                                     scan_mxu)
+            tail(vals_ref[0], None, route_refs, scan_refs, *ext)
 
     return kernel
+
+
+def _split_refs(rest, aligned: bool, scan_mxu: bool):
+    """Split a kernel's trailing positional refs into (route_refs,
+    scan_refs, extraction refs + out_ref) per the level form."""
+    n_route = 1 if aligned else 3
+    n_scan = 2 if scan_mxu else 1
+    return (
+        tuple(rest[:n_route]),
+        tuple(rest[n_route:n_route + n_scan]),
+        tuple(rest[n_route + n_scan:]),
+    )
 
 
 def _extract_kernel_body(kind: str = "sum"):
@@ -1256,13 +1624,17 @@ def _stage_order(blocks):
 def _narrowed_dtype(arrs, dtype):
     """Widen rather than wrap when a stream outgrows its narrow dtype
     (the final level's es2 rows scale with vp//128, which PackConfig
-    cannot bound)."""
+    cannot bound; mxu bk planes scale with segment row span).  Widens
+    to the NARROWEST integer type that holds the level's actual value
+    range — the ledger prices every shipped table at this dtype."""
     if np.issubdtype(dtype, np.integer):
-        info = np.iinfo(dtype)
         lo = min(int(a.min()) for a in arrs)
         hi = max(int(a.max()) for a in arrs)
-        if lo < info.min or hi > info.max:
-            return np.dtype(np.int32)
+        for cand in (dtype, np.dtype(np.int16), np.dtype(np.int32)):
+            info = np.iinfo(cand)
+            if lo >= info.min and hi <= info.max:
+                return np.dtype(cand)
+        return np.dtype(np.int64)
     return np.dtype(dtype)
 
 
@@ -1284,9 +1656,9 @@ def _stack_blocks(lv: LevelPlan, nbytes_only: bool = False):
 
     blocks = [lv.blocks[i] for i in _stage_order(lv.blocks)]
 
-    def st(get, dtype):
+    def st(name, get):
         arrs = [np.asarray(get(b)) for b in blocks]
-        dtype = _narrowed_dtype(arrs, dtype)
+        dtype = _narrowed_dtype(arrs, np.dtype(_STREAM_DTYPES[name]))
         if nbytes_only:
             return sum(a.size for a in arrs) * dtype.itemsize
         return np.stack(arrs).astype(dtype)
@@ -1294,22 +1666,25 @@ def _stack_blocks(lv: LevelPlan, nbytes_only: bool = False):
     if blocks[0].route_rows is not None:
         # composed fold level: the merge route is one sublane-gather
         # row plane — 3x fewer index streams than a generic Route3
-        d = {
-            "rr": st(lambda b: b.route_rows, np.int16),
-            "flags": st(lambda b: b.flags, np.int8),
-        }
+        d = {"rr": st("rr", lambda b: b.route_rows)}
     else:
         d = {
-            "l1": st(lambda b: b.route.l1, np.int8),
-            "s2": st(lambda b: b.route.s2, np.int16),
-            "l3": st(lambda b: b.route.l3, np.int8),
-            "flags": st(lambda b: b.flags, np.int8),
+            "l1": st("l1", lambda b: b.route.l1),
+            "s2": st("s2", lambda b: b.route.s2),
+            "l3": st("l3", lambda b: b.route.l3),
         }
+    if blocks[0].scan_mxu:
+        # mxu scan levels ship the restoration planes instead of the
+        # flag plane (the ladder flag is derivable: ps==lane & bk==0)
+        d["ps"] = st("ps", lambda b: b.ps)
+        d["bk"] = st("bk", lambda b: b.bk)
+    else:
+        d["flags"] = st("flags", lambda b: b.flags)
     if lv.blocks[0].tiles is not None:
         # final level: per-row-range tile extraction routes
-        def tst(get, dtype):
+        def tst(name, get):
             arrs = [np.asarray(get(t)) for b in blocks for t in b.tiles]
-            dtype = _narrowed_dtype(arrs, dtype)
+            dtype = _narrowed_dtype(arrs, np.dtype(_STREAM_DTYPES[name]))
             if nbytes_only:
                 return sum(a.size for a in arrs) * dtype.itemsize
             nt = len(blocks[0].tiles)
@@ -1318,24 +1693,33 @@ def _stack_blocks(lv: LevelPlan, nbytes_only: bool = False):
             )
             return out.astype(dtype)
 
-        d["tel1"] = tst(lambda t: t[0].l1, np.int8)
-        d["tes2"] = tst(lambda t: t[0].s2, np.int16)
-        d["tel3"] = tst(lambda t: t[0].l3, np.int8)
+        d["tel1"] = tst("tel1", lambda t: t[0].l1)
+        d["tes2"] = tst("tes2", lambda t: t[0].s2)
+        d["tel3"] = tst("tel3", lambda t: t[0].l3)
         d["teval"] = tst(
-            lambda t: t[1].reshape(lv.tile_sub, C), np.int8
+            "teval", lambda t: t[1].reshape(lv.tile_sub, C)
         )
     else:
-        d["el1"] = st(lambda b: b.eroute.l1, np.int8)
-        d["es2"] = st(lambda b: b.eroute.s2, np.int16)
-        d["el3"] = st(lambda b: b.eroute.l3, np.int8)
-        d["eval"] = st(
-            lambda b: b.out_valid.reshape(lv.out_sub, C), np.int8
-        )
+        # no out-validity plane: unrouted compact slots carry garbage
+        # that downstream levels isolate as its own flagged segment
+        # (same proof that removed the scan's validity select in r6)
+        d["el1"] = st("el1", lambda b: b.eroute.l1)
+        d["es2"] = st("es2", lambda b: b.eroute.s2)
+        d["el3"] = st("el3", lambda b: b.eroute.l3)
     if lv.has_gather:
-        d["sub_idx"] = st(lambda b: b.sub_idx, np.int16)
-        d["hub_sel"] = st(lambda b: b.hub_sel, np.int16)
+        # one merged index plane: >= 0 is the x-table row, < 0 encodes
+        # the hub slot as -1 - hub_idx (halves the gather index bytes
+        # vs the old separate sub_idx/hub_sel pair)
+        d["gidx"] = st(
+            "gidx",
+            lambda b: np.where(
+                b.hub_sel >= 0,
+                -1 - b.hub_sel.astype(np.int32),
+                b.sub_idx.astype(np.int32),
+            ),
+        )
         if lv.blocks[0].w is not None:
-            d["w"] = st(lambda b: b.w, np.float32)
+            d["w"] = st("w", lambda b: b.w)
     return d
 
 
@@ -1363,6 +1747,10 @@ class LevelSkel:
     # composed fold level: merge route ships as one sublane-gather
     # plane ("rr") instead of a 3-stage Route3
     aligned: bool = False
+    # MXU scan level: ps/bk restoration planes ship instead of flags;
+    # kind=="sum" rides the triangular-matmul prefix, min/max the
+    # ladder with the derived flag
+    mxu: bool = False
 
 
 def _skel_of(lv: LevelPlan, span: int) -> LevelSkel:
@@ -1387,6 +1775,7 @@ def _skel_of(lv: LevelPlan, span: int) -> LevelSkel:
         order=order,
         aligned=bool(lv.blocks
                      and lv.blocks[0].route_rows is not None),
+        mxu=bool(lv.blocks and lv.blocks[0].scan_mxu),
     )
 
 
@@ -1447,12 +1836,18 @@ def _run_level_dev(cfg: PackConfig, skel: LevelSkel, dev, x_tab, hub_tab,
         return parts
 
     if skel.aligned:
-        route_in = [dev["rr"], dev["flags"]]
-        route_specs = [bspec(sub), bspec(sub)]
+        route_in = [dev["rr"]]
+        route_specs = [bspec(sub)]
     else:
         rmid = dev["s2"].shape[-2]
-        route_in = [dev["l1"], dev["s2"], dev["l3"], dev["flags"]]
-        route_specs = [bspec(rmid), bspec(rmid), bspec(sub), bspec(sub)]
+        route_in = [dev["l1"], dev["s2"], dev["l3"]]
+        route_specs = [bspec(rmid), bspec(rmid), bspec(sub)]
+    if skel.mxu:
+        route_in += [dev["ps"], dev["bk"]]
+        route_specs += [bspec(sub), bspec(sub)]
+    else:
+        route_in.append(dev["flags"])
+        route_specs.append(bspec(sub))
 
     def unsort(outs_sorted):
         outs = [None] * nb
@@ -1467,9 +1862,10 @@ def _run_level_dev(cfg: PackConfig, skel: LevelSkel, dev, x_tab, hub_tab,
         cs_sorted = []
         off = 0
         for stages, cnt in groups:
-            scan_kernel = _kernel_body(False, sub, sub, cfg.hub, stages,
+            scan_kernel = _kernel_body(False, sub, sub, stages,
                                        kind, False, extract=False,
-                                       aligned=skel.aligned)
+                                       aligned=skel.aligned,
+                                       scan_mxu=skel.mxu)
             cs = pl.pallas_call(
                 scan_kernel,
                 grid=(cnt,),
@@ -1514,15 +1910,15 @@ def _run_level_dev(cfg: PackConfig, skel: LevelSkel, dev, x_tab, hub_tab,
 
     ermid = dev["es2"].shape[-2]
     common_in = route_in + [
-        dev["el1"], dev["es2"], dev["el3"], dev["eval"],
+        dev["el1"], dev["es2"], dev["el3"],
     ]
     common_specs = route_specs + [
-        bspec(ermid), bspec(ermid), bspec(out_sub), bspec(out_sub),
+        bspec(ermid), bspec(ermid), bspec(out_sub),
     ]
 
     if skel.has_gather:
-        stacked = [dev["sub_idx"], dev["hub_sel"]]
-        stacked_specs = [bspec(sub), bspec(sub)]
+        stacked = [dev["gidx"]]
+        stacked_specs = [bspec(sub)]
         if has_w:
             stacked.append(dev["w"])
             stacked_specs.append(bspec(sub))
@@ -1531,7 +1927,7 @@ def _run_level_dev(cfg: PackConfig, skel: LevelSkel, dev, x_tab, hub_tab,
         invariant = [x_tab, hub_tab]
         inv_specs = [
             pl.BlockSpec((sub, C), lambda i: (0, 0)),
-            pl.BlockSpec((cfg.hub // C, C), lambda i: (0, 0)),
+            pl.BlockSpec((sub, C), lambda i: (0, 0)),
         ]
         parts_sorted = None
     else:
@@ -1545,8 +1941,9 @@ def _run_level_dev(cfg: PackConfig, skel: LevelSkel, dev, x_tab, hub_tab,
     outs_sorted = []
     off = 0
     for stages, cnt in groups:
-        kernel = _kernel_body(skel.has_gather, sub, out_sub, cfg.hub,
-                              stages, kind, has_w, aligned=skel.aligned)
+        kernel = _kernel_body(skel.has_gather, sub, out_sub,
+                              stages, kind, has_w, aligned=skel.aligned,
+                              scan_mxu=skel.mxu)
         args = list(invariant)
         specs = list(inv_specs)
         if parts_sorted is not None:
@@ -1592,7 +1989,13 @@ def _exec_levels(x, cfg: PackConfig, vp: int, n_cols: int, level_list,
         [x, jnp.zeros((n_pass * span - n_cols,), x.dtype)]
     ) if n_pass * span != n_cols else x
     x_passes = x_pad.reshape(n_pass, cfg.sub, C)
-    hub_tab = x[hub_cols].reshape(cfg.hub // C, C)
+    # hub table padded to [sub, C]: Mosaic's sublane dynamic gather
+    # requires table shape == index shape, so the kernel reads hubs
+    # with two shape-matched gathers instead of a register loop
+    hub_tab = jnp.concatenate([
+        x[hub_cols].reshape(cfg.hub // C, C),
+        jnp.zeros((cfg.sub - cfg.hub // C, C), x.dtype),
+    ]) if cfg.sub > cfg.hub // C else x[hub_cols].reshape(cfg.sub, C)
 
     streams = []
     for skel, dev in level_list[:-1]:
@@ -1621,7 +2024,10 @@ def segment_reduce_pack(x, plan: PackPlan, kind: str = "sum",
     kind selects the semiring: "sum" (weights multiply — classic
     SpMV), "min"/"max" (weights add — the tropical relaxation of
     SSSP/BFS; rows with no edges yield the identity, matching
-    jax.ops.segment_min).  One plan serves every kind.
+    jax.ops.segment_min).  One plan serves every kind.  "sum" under
+    the default MXU scan assumes FINITE inputs (prefix differences
+    spread a non-finite value across its block — see _scan_np_mxu);
+    min/max carry inf sentinels safely (they always run the ladder).
 
     Usable inside jit; all static structure is closed over as device
     constants.  `interpret=None` auto-selects compiled-on-TPU.
@@ -1758,17 +2164,21 @@ def plan_pack_multi(shards, vp: int, n_cols: int,
     # span-aware scans unroll a static stage count; under shard_map all
     # shards run one traced program, so unify each block's stages to
     # the per-block max across shards (extra stages are bit-exact
-    # no-ops for the shard that needed fewer)
+    # no-ops for the shard that needed fewer), then decide the
+    # level-wide scan form from the ALL-shard block set so every
+    # shard's skeleton engages identically
     for li in range(len(all_levels[0])):
         for bj in range(len(all_levels[0][li].blocks)):
             s = max(all_levels[f][li].blocks[bj].scan_stages
                     for f in range(fnum))
             for f in range(fnum):
-                blk = all_levels[f][li].blocks[bj]
-                if blk.scan_stages != s:
-                    blk.scan_stages = s
-                    blk.ledger = {**blk.ledger,
-                                  "scan": 3 * s * cfg.slots}
+                all_levels[f][li].blocks[bj].scan_stages = s
+        blocks_all = [b for f in range(fnum)
+                      for b in all_levels[f][li].blocks]
+        mxu = _decide_level_scan(blocks_all)
+        for b in blocks_all:
+            b.scan_mxu = mxu
+            b.ledger = _reledger_block(cfg, b)
 
     if not pass_idxs:
         # zero edges on every shard
@@ -1894,7 +2304,7 @@ def plan_pack_for_fragment(frag, cfg: PackConfig = PackConfig(),
     if frag.fnum != 1:
         return None
     per_frag = _frag_cache(frag)
-    key = (cfg, with_weights, direction, "single")
+    key = (cfg, with_weights, direction, "single", _scan_mode())
     if key in per_frag:
         return per_frag[key]
     shard = _shard_edges(frag, 0, with_weights, direction)
@@ -1914,7 +2324,7 @@ def plan_pack_multi_for_fragment(frag, cfg: PackConfig = PackConfig(),
     shard of `frag` — the pack path's multi-chip form (VERDICT r2
     missing #2: the perf path and the mesh must compose)."""
     per_frag = _frag_cache(frag)
-    key = (cfg, with_weights, direction, "multi")
+    key = (cfg, with_weights, direction, "multi", _scan_mode())
     if key in per_frag:
         return per_frag[key]
     shards = []
@@ -2025,7 +2435,7 @@ def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
     cfg = cfg or PackConfig.from_env()
     per_frag = _frag_cache(frag)
     key = (cfg, with_weights, direction, "dispatch",
-           mirror.uid if mirror is not None else 0)
+           mirror.uid if mirror is not None else 0, _scan_mode())
     if key in per_frag:
         mplan = per_frag[key]
         return PackDispatch(
@@ -2072,16 +2482,30 @@ def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
 # exact edge streams + geometry + schema version, stored as one .npz of
 # the stacked stream tables under $GRAPE_PACK_PLAN_CACHE.
 
-_PLAN_SCHEMA_VERSION = 2
+_PLAN_SCHEMA_VERSION = 3
+
+# the narrow target dtype of every shipped stream table, in one place
+# so the plan-cache digest fingerprints the dtype layout a plan was
+# built with — widening beyond the target is value-driven
+# (_narrowed_dtype) and thus already a function of the digested edge
+# streams
+_STREAM_DTYPES = {
+    "rr": "int16", "l1": "int8", "s2": "int16", "l3": "int8",
+    "flags": "int8", "ps": "int8", "bk": "int8",
+    "el1": "int8", "es2": "int16", "el3": "int8",
+    "tel1": "int8", "tes2": "int16", "tel3": "int8", "teval": "int8",
+    "gidx": "int16", "w": "float32",
+}
 
 
 def _shards_digest(shards, vp: int, n_cols: int, cfg: PackConfig) -> str:
     """Content key for cached plans.  The config prefix fingerprints
     the FULL PackConfig (every dataclass field, so a future knob can't
-    silently alias two configs), the stream dtypes, the schema version
-    and the planner modes — a config or dtype change therefore
-    invalidates stale cached plans instead of loading a mismatched
-    one."""
+    silently alias two configs), the input stream dtypes, the shipped
+    stream dtype table, the schema version and the planner modes —
+    including GRAPE_PACK_SCAN, so a scan-mode flip invalidates stale
+    cached plans instead of loading ones whose shipped planes belong
+    to the other kernel family."""
     import dataclasses
     import hashlib
 
@@ -2092,6 +2516,8 @@ def _shards_digest(shards, vp: int, n_cols: int, cfg: PackConfig) -> str:
         "cfg": dataclasses.asdict(cfg),
         "final_tile_sub": _FINAL_TILE_SUB,
         "compose": _compose_enabled(),
+        "scan": _scan_mode(),
+        "stream_dtypes": _STREAM_DTYPES,
         "vp": vp,
         "n_cols": n_cols,
         "dtypes": [

@@ -234,7 +234,43 @@ class Worker:
                 out_specs=(carry_specs, P(), P()),
                 check_vma=False,
             )
-            return jax.jit(sm)
+
+            # the watchdog digest (and the stagnation residual) ride
+            # out of the chunk as extra outputs of the SAME jitted
+            # dispatch — computed on the global post-collective carry,
+            # so they are value-identical to the monitor's own probe
+            # (same carry_digest function, same masked-residual rule)
+            # and the guarded-fused path pays no extra device dispatch
+            # for them (ROADMAP "Watchdog on device")
+            from libgrape_lite_tpu.guard.watchdog import carry_digest
+
+            float_keys = sorted(
+                k for k, v in state.items()
+                if k not in eph and np.dtype(v.dtype).kind == "f"
+            )
+
+            def with_digest(frag_stacked, st, eph_state, active0, r0):
+                out, rounds, active = sm(
+                    frag_stacked, st, eph_state, active0, r0
+                )
+                dig = carry_digest(out)
+                if float_keys:
+                    diffs = [
+                        jnp.max(jnp.where(
+                            jnp.isfinite(d), d, jnp.float32(0)
+                        ))
+                        for k in float_keys
+                        for d in [jnp.abs(
+                            out[k].astype(jnp.float32)
+                            - st[k].astype(jnp.float32)
+                        )]
+                    ]
+                    res = jnp.max(jnp.stack(diffs))
+                else:
+                    res = jnp.float32(-1)
+                return out, rounds, active, dig, res
+
+            return jax.jit(with_digest)
 
         return compile_for
 
@@ -377,10 +413,12 @@ class Worker:
             f"(policy={guard_cfg.policy})",
         )
 
-        def observe(prev, cur, rounds, active):
+        def observe(prev, cur, rounds, active, digest=None,
+                    residual=None):
             if active < 0:  # cooperative abort is the app's own verdict
                 return
-            breach = monitor.check(prev, cur, rounds, active)
+            breach = monitor.check(prev, cur, rounds, active,
+                                   digest=digest, residual=residual)
             if breach is not None:
                 # rollback needs a checkpointed stepwise run; the
                 # monitor already downgraded + logged, so anything
@@ -395,12 +433,19 @@ class Worker:
         chunk_fn = self._chunk_runner_for(guard_cfg.every, mr, state)
         while int(active) > 0 and rounds < mr:
             prev = carry
-            carry, r2, active = jax.block_until_ready(
+            carry, r2, active, dig, res = jax.block_until_ready(
                 chunk_fn(frag.dev, carry, eph_part,
                          jnp.int32(int(active)), jnp.int32(rounds))
             )
             rounds = int(r2)
-            observe(prev, carry, rounds, int(active))
+            # digest + residual rode out of the chunk dispatch itself;
+            # the monitor skips its own probe when the app declares no
+            # invariants, making guarded-fused probing free of extra
+            # host syncs
+            res_f = float(res)
+            observe(prev, carry, rounds, int(active),
+                    digest=tuple(int(x) for x in np.asarray(dig)),
+                    residual=None if res_f < 0 else res_f)
         self.rounds = rounds
         self._terminate_code = min(0, int(active))
         self._result_state = {**carry, **eph_part}
@@ -576,10 +621,11 @@ class Worker:
             )
             glog.vlog(
                 1,
-                f"pack op-budget: {t['alu_ops'] / e:.1f} ALU ops/edge, "
+                f"pack op-budget: {t['vpu_ops'] / e:.1f} VPU ops/edge, "
+                f"{t['mxu_ops'] / e:.1f} MXU elems/edge, "
                 f"{t['gather_rows'] / e:.2f} gather rows/edge over "
                 f"{t['blocks']} blocks / {len(led['levels'])} levels "
-                f"(per-stage ops/edge: {stages})",
+                f"(per-stage VPU ops/edge: {stages})",
             )
         inc_fn = self._compile_single_step("inceval", state)
         # ephemeral leaves drop out of each step's outputs; re-merge the
@@ -791,8 +837,8 @@ class Worker:
             return None
         if len(ledgers) == 1:
             return ledgers[0]
-        totals = {"alu_ops": 0, "gather_rows": 0, "hbm_bytes": 0,
-                  "blocks": 0, "per_stage": {}}
+        totals = {"vpu_ops": 0, "mxu_ops": 0, "gather_rows": 0,
+                  "hbm_bytes": 0, "blocks": 0, "per_stage": {}}
         out = {"edges": 0, "levels": [], "totals": totals}
         for di, led in enumerate(ledgers):
             out["edges"] += led["edges"]
@@ -804,7 +850,8 @@ class Worker:
                  "dispatch": di}
                 for i, lv in enumerate(led["levels"])
             ]
-            for k in ("alu_ops", "gather_rows", "hbm_bytes", "blocks"):
+            for k in ("vpu_ops", "mxu_ops", "gather_rows",
+                      "hbm_bytes", "blocks"):
                 totals[k] += led["totals"][k]
             for k, v in led["totals"].get("per_stage", {}).items():
                 totals["per_stage"][k] = (

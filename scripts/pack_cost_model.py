@@ -5,23 +5,35 @@ no-hardware fallback for pricing `ops/spmv_pack.py` (VERDICT r3 next
 from the real plan, not hand-waved constants).
 
 r6: the model CONSUMES the planner's static op-budget ledger
-(`spmv_pack.plan_ledger` — exact per-stage vector-ALU op counts
-annotated on every BlockPlan at plan time) instead of re-deriving its
-own estimates, and independently RECOUNTS the same quantities from the
-shipped device stream arrays (segment runs decoded from the flag
-planes, route stage heights from the actual index-block shapes).  A
-ledger/recount disagreement > 5% fails the script — and bench.py, which
-embeds the ledger totals in the BENCH json, fails the same way.
+(`spmv_pack.plan_ledger` — exact per-stage op counts annotated on
+every BlockPlan at plan time) instead of re-deriving its own
+estimates, and independently RECOUNTS the same quantities from the
+shipped device stream arrays (segment runs decoded from the flag or
+ps/bk planes, route stage heights from the actual index-block shapes).
+A ledger/recount disagreement > 5% on either engine column fails the
+script — and bench.py, which embeds the ledger totals in the BENCH
+json, fails the same way.
+
+r7: the ledger carries separate `vpu_ops` / `mxu_ops` / `hbm_bytes`
+columns.  MXU-scan levels (GRAPE_PACK_SCAN=mxu, the default) replace
+the 3-ops-per-stage shift ladder with triangular-matmul prefix sums:
+a flat 10 VPU restoration ops per slot plus 3 matmul output planes
+priced at the MXU's measured cumsum rate.
 
 Counting conventions are documented on `spmv_pack._block_op_ledger`;
-the ledger prices, per block: the 2-op hub overlay, route moves at
-their true operand heights (a composed lane-aligned fold route is ONE
-sublane move, a generic Route3 is three), the `flags != 1` compare,
-3 ops per span-aware scan stage (ceil(log2(max_seglen)) stages instead
-of the unconditional log2(SUB*128) ladder), and the extraction stages.
+the ledger prices, per block: the 3-op hub overlay (the per-row hub
+-group reduce + two shape-matched gathers from the padded hub table;
+the planner row-aligns hub slots so the sublane gather's row index is
+lane-uniform), route moves at their true operand
+heights (a composed lane-aligned fold route is ONE sublane move, a
+generic Route3 is three), the `flags != 1` compare on shift levels,
+the span-aware shift ladder or the flat mxu restoration, and the
+extraction stages (validity select dropped on non-final levels).
 Cycle rates are explicit v5e assumptions:
 
   * vector ALU: 1024 f32 lanes/cycle (one (8,128) vreg op/cycle),
+  * MXU: 0.008 cyc per matmul output element at B >= 512 (the
+    verified [B,128] @ tri[128,128] Mosaic lowering),
   * sublane dynamic_gather: bounded between 1 row/cycle and ~8
     cycles/row (Mosaic unroll) — THE unknown the probe measures,
   * HBM: 819 GB/s, stream bytes counted from the plan's real dtypes.
@@ -54,6 +66,7 @@ BASELINE_MTEPS = 3500.0       # reference 8xV100 PageRank, per chip
 # (8,128) vector gathered per cycle, row = one 128-lane row per cycle,
 # unroll = Mosaic falls back to ~8-way select unrolling
 GATHER_RATES = {"vreg": 1024, "row": 128, "unroll": 16}
+MXU_CYC_PER_ELEM = 0.008      # verified triangular-matmul cumsum rate
 MISMATCH_TOLERANCE = 0.05
 
 
@@ -76,17 +89,19 @@ def build_bench_plan(scale: int, ef: int):
 
 
 def independent_op_estimate(plan) -> dict:
-    """Recount ALU ops and gather rows from the SHIPPED device stream
-    arrays, independently of the planner's BlockPlan annotations:
-    segment runs are decoded from the flag planes, route/extraction
-    stage costs from the actual index-block shapes.  This is the
-    cross-check that keeps `plan_ledger` honest."""
+    """Recount VPU ops, MXU elems and gather rows from the SHIPPED
+    device stream arrays, independently of the planner's BlockPlan
+    annotations: segment runs are decoded from the flag planes (or,
+    on mxu levels, from the ps/bk restoration planes via the derived
+    start flag `ps == lane & bk == 0`), route/extraction stage costs
+    from the actual index-block shapes.  This is the cross-check that
+    keeps `plan_ledger` honest."""
     from libgrape_lite_tpu.ops.spmv_pack import _stack_blocks
 
     levels = list(plan.levels)
     if plan.final is not None and plan.final.blocks:
         levels.append(plan.final)
-    tot = {"alu_ops": 0, "gather_rows": 0}
+    tot = {"vpu_ops": 0, "mxu_ops": 0, "gather_rows": 0}
     for lv in levels:
         if not lv.blocks:
             continue
@@ -94,7 +109,6 @@ def independent_op_estimate(plan) -> dict:
         nb = len(lv.blocks)
         slots = lv.cfg.sub * C
         for b in range(nb):
-            fl = d["flags"][b].reshape(-1).astype(np.int64)
             ops = 0
             # merge/restore route: one sublane move when composed
             # lane-aligned, else the three stages at their heights
@@ -103,48 +117,77 @@ def independent_op_estimate(plan) -> dict:
             else:
                 ops += (d["l1"].shape[-2] + d["s2"].shape[-2]
                         + d["l3"].shape[-2]) * C
-            ops += slots  # the flags != 1 compare
-            # span-aware scan stages, re-derived from the flag plane
-            e = int(((fl & 1) > 0).sum())
-            if e:
-                starts = np.flatnonzero((fl & 2) > 0)
-                runs = np.diff(np.concatenate([starts, [e]]))
-                mx = int(runs.max()) if len(runs) else 1
-                stages = max(0, math.ceil(math.log2(max(1, mx))))
+            if "ps" in d:
+                # mxu level: flat restoration cost — 10 VPU ops and 3
+                # matmul output planes per slot, HARDCODED here as the
+                # independent codification of the documented
+                # convention (importing spmv_pack's constants would
+                # make this gate tautological: a planner-side constant
+                # drift must trip the 5% mismatch, not follow it).
+                # The ps/bk planes are also decoded for consistency:
+                # the derived start flag (ps == lane & bk == 0) must
+                # mark at least one start per block that ships edges.
+                ops += 10 * slots
+                tot["mxu_ops"] += 3 * slots
+                ps = d["ps"][b].astype(np.int64)
+                bk = d["bk"][b].astype(np.int64)
+                lane = np.arange(C, dtype=np.int64)[None, :]
+                f0 = (ps == lane) & (bk == 0)
+                assert f0.any(), (
+                    "mxu restoration planes decode to zero segment "
+                    "starts — ps/bk are corrupt"
+                )
             else:
-                stages = 0
-            ops += 3 * stages * slots
-            # extraction: compact eroute or final row-range tiles
+                fl = d["flags"][b].reshape(-1).astype(np.int64)
+                ops += slots  # the flags != 1 compare
+                # span-aware scan stages, re-derived from the flags
+                e = int(((fl & 1) > 0).sum())
+                if e:
+                    starts = np.flatnonzero((fl & 2) > 0)
+                    runs = np.diff(np.concatenate([starts, [e]]))
+                    mx = int(runs.max()) if len(runs) else 1
+                    stages = max(0, math.ceil(math.log2(max(1, mx))))
+                else:
+                    stages = 0
+                ops += 3 * stages * slots
+            # extraction: compact eroute (no validity select) or
+            # final row-range tiles (select survives: tile outputs
+            # sum straight into the dense result)
             if "el1" in d:
                 ops += (d["el1"].shape[-2] + d["es2"].shape[-2]
-                        + 2 * d["el3"].shape[-2]) * C
+                        + d["el3"].shape[-2]) * C
             elif "tel1" in d:
                 nt = d["tel1"].shape[1]
                 ops += nt * (d["tel1"].shape[-2] + d["tes2"].shape[-2]
                              + 2 * d["teval"].shape[-2]) * C
-            if "sub_idx" in d:
-                ops += 2 * slots          # hub overlay selects
+            if "gidx" in d:
+                # hub-group reduce + the two hub-table gathers
+                ops += 3 * slots
                 tot["gather_rows"] += slots
-            tot["alu_ops"] += ops
+            tot["vpu_ops"] += ops
     return tot
 
 
 def price(totals: dict, edges: int) -> dict:
     """Wall-clock + MTEPS bracket from ledger totals under the explicit
-    v5e rates; the gather rate is bracketed (the probe's unknown)."""
-    alu_s = totals["alu_ops"] / VPU_LANES_PER_CYCLE / CLOCK_HZ
+    v5e rates; the gather rate is bracketed (the probe's unknown).
+    VPU, MXU and gather time are summed (no overlap assumed — the
+    conservative bound); HBM streams concurrently."""
+    vpu_s = totals["vpu_ops"] / VPU_LANES_PER_CYCLE / CLOCK_HZ
+    mxu_s = totals["mxu_ops"] * MXU_CYC_PER_ELEM / CLOCK_HZ
     hbm_s = totals["hbm_bytes"] / HBM_BPS
     scenarios = {}
     for name, rate in GATHER_RATES.items():
         g_s = totals["gather_rows"] / rate / CLOCK_HZ
-        t = max(alu_s + g_s, hbm_s)
+        t = max(vpu_s + mxu_s + g_s, hbm_s)
         scenarios[name] = dict(
             gather_ms=round(g_s * 1e3, 2),
             round_ms=round(t * 1e3, 2),
             mteps=round(edges / t / 1e6, 0),
             vs_baseline_3500=round(edges / t / 1e6 / BASELINE_MTEPS, 2),
         )
-    return dict(t_alu_ms=round(alu_s * 1e3, 2),
+    return dict(t_vpu_ms=round(vpu_s * 1e3, 2),
+                t_mxu_ms=round(mxu_s * 1e3, 2),
                 t_hbm_ms=round(hbm_s * 1e3, 2),
                 scenarios=scenarios)
 
@@ -159,20 +202,24 @@ def model(scale: int, ef: int) -> dict:
     recount = independent_op_estimate(plan)
     totals = ledger["totals"]
     e = ledger["edges"]
-    mismatch = abs(totals["alu_ops"] - recount["alu_ops"]) / max(
-        1, totals["alu_ops"]
+    mismatch = max(
+        abs(totals[k] - recount[k]) / max(1, totals[k])
+        for k in ("vpu_ops", "mxu_ops")
     )
     summary = dict(
         edges=e,
         bytes_per_edge=round(totals["hbm_bytes"] / e, 1),
-        alu_ops_per_edge=round(totals["alu_ops"] / e, 1),
+        vpu_ops_per_edge=round(totals["vpu_ops"] / e, 1),
+        mxu_elems_per_edge=round(totals["mxu_ops"] / e, 1),
         gather_slots_per_edge=round(totals["gather_rows"] / e, 2),
         per_stage_ops_per_edge={
             k: round(v / e, 1)
             for k, v in sorted(totals["per_stage"].items())
         },
-        ledger_alu_ops=totals["alu_ops"],
-        recount_alu_ops=recount["alu_ops"],
+        ledger_vpu_ops=totals["vpu_ops"],
+        recount_vpu_ops=recount["vpu_ops"],
+        ledger_mxu_ops=totals["mxu_ops"],
+        recount_mxu_ops=recount["mxu_ops"],
         ledger_recount_mismatch=round(mismatch, 4),
         **price(totals, e),
     )
@@ -191,6 +238,7 @@ def bench_ledger_summary(scale: int, ef: int,
         _PLAN_SCHEMA_VERSION,
         PackConfig,
         _compose_enabled,
+        _scan_mode,
     )
 
     import hashlib
@@ -211,6 +259,7 @@ def bench_ledger_summary(scale: int, ef: int,
         "cfg": dataclasses.asdict(PackConfig.from_env()),
         "schema": _PLAN_SCHEMA_VERSION,
         "compose": _compose_enabled(),
+        "scan": _scan_mode(),
         "code": code_fp.hexdigest(),
     })[:16]
     path = (os.path.join(cache_dir, f"ledger_{key}.json")

@@ -32,11 +32,21 @@ os.environ.setdefault("GRAPE_PACK_PLAN_CACHE", PLAN_CACHE_DIR)
 from libgrape_lite_tpu.ops.spmv_pack import resolve_pack_dispatch
 
 n, src, dst, comm_spec, vm, frag = build_bench_fragment()
-d = resolve_pack_dispatch(frag)
-print("pagerank plan:", "ok" if d is not None else "MISSED", flush=True)
-
 frag_w = build_bench_weighted_fragment(src, dst, comm_spec, vm)
-dw = resolve_pack_dispatch(frag_w, with_weights=True)
-print("sssp plan:", "ok" if dw is not None else "MISSED", flush=True)
 
-sys.exit(0 if (d is not None and dw is not None) else 1)
+# seed BOTH scan modes: the live-window A/B (GRAPE_PACK_SCAN=mxu vs
+# shift, tpu_first_light step 2b) must not burn live minutes on the
+# O(E log E) planner; the cache digest fingerprints the mode, so each
+# seeds its own entry
+ok = True
+for mode in ("mxu", "shift"):
+    os.environ["GRAPE_PACK_SCAN"] = mode
+    d = resolve_pack_dispatch(frag)
+    print(f"pagerank plan [{mode}]:",
+          "ok" if d is not None else "MISSED", flush=True)
+    dw = resolve_pack_dispatch(frag_w, with_weights=True)
+    print(f"sssp plan [{mode}]:",
+          "ok" if dw is not None else "MISSED", flush=True)
+    ok = ok and d is not None and dw is not None
+
+sys.exit(0 if ok else 1)

@@ -38,6 +38,12 @@ GRAPE_BENCH_ASSUME_ALIVE=1 timeout 3600 python bench.py \
 # silent xla-only A/B must not read as a pack measurement
 grep -iE "pack|warn" "$OUT/bench.err" | tail -10 || true
 
+echo "== scan A/B (mxu triangular-matmul scan vs shift ladder; both
+plans pre-seeded by scripts/seed_pack_plans.py) =="
+GRAPE_BENCH_ASSUME_ALIVE=1 GRAPE_SPMV=pack GRAPE_PACK_SCAN=shift \
+  timeout 3600 python bench.py \
+  2> "$OUT/bench_shift.err" | tee "$OUT/bench_shift.json" || true
+
 echo "== per-stage profile (stepwise mode, per-round wall clock) =="
 GRAPE_SPMV=pack GRAPE_TPU_VLOG=1 timeout 1200 python - <<'EOF' 2>&1 | tee "$OUT/profile.log" || true
 import sys

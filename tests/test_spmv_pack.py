@@ -705,3 +705,48 @@ def test_sssp_pack_end_to_end(monkeypatch):
     finite = np.isfinite(ref)
     np.testing.assert_allclose(got[finite], ref[finite], rtol=1e-6)
     assert np.isinf(got[~finite]).all()
+
+
+def test_multi_group_hub_table():
+    """Hub table spanning several 128-entry groups (hub > 128): the
+    kernel's two-gather hub read requires the planner's row-aligned
+    group assignment — a per-slot row index would read the row plane
+    at post-lane-gather positions (the r7 CLI-caught bug).  Exercises
+    numpy and interpret paths at hub=512 (4 groups)."""
+    import jax.numpy as jnp
+
+    from libgrape_lite_tpu.ops.spmv_pack import segment_sum_pack
+
+    rng = np.random.default_rng(2)
+    cfg = PackConfig(sub=16, out_sub=8, hub=512)
+    e, vp = 8000, 2048
+    cols = np.where(
+        rng.random(e) < 0.5, rng.integers(0, 600, e),
+        rng.integers(0, vp, e),
+    ).astype(np.int64)
+    rows = np.sort(rng.integers(0, vp, e))
+    plan = plan_pack(rows, cols, vp, vp, cfg)
+    # several hub groups must actually be referenced
+    grps = set()
+    for lv in plan.levels:
+        if lv.has_gather:
+            for b in lv.blocks:
+                hs = b.hub_sel[b.hub_sel >= 0]
+                grps |= set((hs >> 7).tolist())
+                # the kernel invariant: one hub group per kernel row
+                hrow = np.nonzero(b.hub_sel >= 0)
+                for r in np.unique(hrow[0]):
+                    rg = b.hub_sel[r][b.hub_sel[r] >= 0] >> 7
+                    assert len(np.unique(rg)) <= 1
+    assert len(grps) > 1, "hub never spanned multiple groups"
+    x = rng.normal(size=vp)
+    want = _reference(rows, cols, x, vp)
+    np.testing.assert_allclose(exec_plan_np(plan, x), want,
+                               rtol=1e-9, atol=1e-9)
+    got = np.asarray(segment_sum_pack(
+        jnp.asarray(x.astype(np.float32)), plan, interpret=True
+    ))
+    np.testing.assert_allclose(
+        got, _reference(rows, cols, x.astype(np.float64), vp),
+        rtol=1e-4, atol=1e-4,
+    )
