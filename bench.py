@@ -37,6 +37,14 @@ extract), the modeled MTEPS bracket under `modeled`, and
 independent recount from the shipped arrays; > 5% on either engine
 column fails the bench after the measurements are printed).
 
+BENCH-json obs fields (r8): `obs` carries the per-phase span rollup
+from the in-memory tracer armed for the whole bench — `spans` maps
+span name (query/peval/superstep/chunk/...) to {count, total_s,
+mean_s, max_s}, `trace_id` ties the record to a GRAPE_TRACE file when
+one was requested.  Every record is self-checked against
+scripts/check_bench_schema.py before printing; schema drift exits 3
+AFTER all measurements are out (ledger drift keeps exit 2).
+
 Baseline derivation (BASELINE.md): the reference GPU backend runs
 PageRank on soc-LiveJournal1 (68.99M directed edges) in 24.65 ms on
 8× V100 (`Performance.md:94`), i.e. 68.99e6 * 10 rounds / 0.02465 s
@@ -145,6 +153,41 @@ def build_bench_weighted_fragment(src, dst, comm_spec, vm):
     )
 
 
+_SCHEMA_ERRORS: list = []
+_VALIDATE_RECORD = None
+
+
+def _validator():
+    """One-time import of the schema checker (the scripts dir goes on
+    sys.path once, not per emitted record)."""
+    global _VALIDATE_RECORD
+    if _VALIDATE_RECORD is None:
+        scripts = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts")
+        if scripts not in sys.path:
+            sys.path.insert(0, scripts)
+        from check_bench_schema import validate_record
+
+        _VALIDATE_RECORD = validate_record
+    return _VALIDATE_RECORD
+
+
+def _emit_record(record) -> None:
+    """Print one BENCH json line, self-checked against the declared
+    schema first (scripts/check_bench_schema.py).  A schema breach is
+    loud on stderr but must never cost a measurement — the line still
+    prints, and main() exits nonzero at the end instead."""
+    try:
+        errs = _validator()(record)
+    except Exception as e:  # checker bugs must not kill the bench
+        errs = [f"schema checker unavailable: {type(e).__name__}: {e}"]
+    if errs:
+        for err in errs:
+            print(f"[bench] SCHEMA: {err}", file=sys.stderr)
+        _SCHEMA_ERRORS.extend(errs)
+    print(json.dumps(record), flush=True)
+
+
 def main():
     suffix = ""
     # ALWAYS probe in a subprocess before touching the default backend:
@@ -169,8 +212,22 @@ def main():
 
     import jax  # noqa: F401 — backend init order matters
 
+    from libgrape_lite_tpu import obs
     from libgrape_lite_tpu.models import PageRank
     from libgrape_lite_tpu.worker.worker import Worker
+
+    # obs/: the BENCH record carries per-phase span rollups.  With
+    # GRAPE_TRACE set the env arms the file-backed tracer itself (its
+    # history feeds the same rollup); otherwise arm in-memory —
+    # keeping any GRAPE_METRICS file sink, which alone would drop
+    # drained events and leave the rollup empty.  The spans are a few
+    # host events per measured query (the fused path is ONE dispatch),
+    # so the rollup costs the measurement nothing observable.
+    if not os.environ.get(obs.TRACE_ENV):
+        obs.configure(
+            in_memory=True,
+            metrics_path=os.environ.get(obs.METRICS_ENV) or None,
+        )
 
     # persist pack plans across bench invocations: a live-TPU window is
     # scarce, and re-running the O(E log E) host planner on every A/B
@@ -271,7 +328,7 @@ def main():
     # death mid-SSSP (the documented r1/r2 failure mode) hangs
     # uninterruptibly, and the driver reads the LAST JSON line — so a
     # completed SSSP lane supersedes this line with the combined record
-    print(json.dumps(record), flush=True)
+    _emit_record(record)
 
     # second north star: SSSP on the same graph, weighted (best-effort —
     # a failure must not cost the PageRank measurement)
@@ -316,7 +373,7 @@ def main():
               file=sys.stderr)
     else:
         if "sssp" in record:
-            print(json.dumps(record), flush=True)
+            _emit_record(record)
 
     # guard overhead lane (r7): guards OFF take literally the same code
     # path as the primary measurement above (Worker.query consults only
@@ -357,7 +414,7 @@ def main():
                 "cadence": cfg.every,
                 "probes": (w_on.guard_report or {}).get("probes", 0),
             }
-            print(json.dumps(record), flush=True)
+            _emit_record(record)
             print(
                 f"[bench] guard: off={t_off:.4f}s on={t_on:.4f}s "
                 f"(+{record['guard']['guarded_overhead_pct']}%)",
@@ -400,7 +457,7 @@ def main():
                 "ledger_recount_mismatch":
                     summ["ledger_recount_mismatch"],
             }
-            print(json.dumps(record), flush=True)
+            _emit_record(record)
             if summ["ledger_recount_mismatch"] > MISMATCH_TOLERANCE:
                 ledger_mismatch = summ["ledger_recount_mismatch"]
         except Exception as e:  # the ledger lane must not cost the bench
@@ -436,6 +493,27 @@ def main():
             except Exception as e:  # side metrics are best-effort
                 print(f"[bench-extra] {nm}: failed ({e})", file=sys.stderr)
 
+    # obs rollup (r8): per-phase span aggregation over every query the
+    # bench ran (warmups included — their compile-heavy first rounds
+    # are why max_s >> mean_s on the query span).  The tracer was armed
+    # in-memory at the top of main(), so this costs no file I/O unless
+    # GRAPE_TRACE asked for it.
+    try:
+        record["obs"] = {
+            "trace_id": obs.trace_id(),
+            "spans": obs.rollup(obs.history()),
+        }
+        _emit_record(record)
+        if os.environ.get(obs.TRACE_ENV) or os.environ.get(
+            obs.METRICS_ENV
+        ):
+            out = obs.flush()
+            print(f"[bench] obs: trace={out['trace']} "
+                  f"metrics={out['metrics']}", file=sys.stderr)
+    except Exception as e:  # the obs lane must not cost the bench
+        print(f"[bench] obs lane failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     if ledger_mismatch is not None:
         print(
             f"[bench] FATAL: op-budget ledger and cost-model recount "
@@ -444,6 +522,14 @@ def main():
             file=sys.stderr,
         )
         sys.exit(2)
+    if _SCHEMA_ERRORS:
+        print(
+            f"[bench] FATAL: {len(_SCHEMA_ERRORS)} BENCH-record schema "
+            "error(s) (see SCHEMA lines above) — the record drifted "
+            "from scripts/check_bench_schema.py",
+            file=sys.stderr,
+        )
+        sys.exit(3)
 
 
 if __name__ == "__main__":

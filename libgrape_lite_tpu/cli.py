@@ -69,6 +69,15 @@ def make_parser() -> argparse.ArgumentParser:
                         "reads GRAPE_GUARD")
     p.add_argument("--profile", action="store_true",
                    help="stepwise rounds with per-round timing (PROFILING)")
+    p.add_argument("--trace", default="",
+                   help="arm obs/ tracing: write a Chrome trace_event "
+                        "JSON (Perfetto-loadable) to this path plus a "
+                        "JSONL twin next to it; equivalent to "
+                        "GRAPE_TRACE=path (docs/OBSERVABILITY.md)")
+    p.add_argument("--metrics", default="",
+                   help="write the obs/ metrics snapshot to "
+                        "<path>.json and <path>.prom at query end; "
+                        "equivalent to GRAPE_METRICS=path")
     p.add_argument("--platform", default="",
                    help="jax platform override (e.g. cpu); default ambient")
     p.add_argument("--cpu_devices", type=int, default=0,

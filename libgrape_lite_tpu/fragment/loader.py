@@ -113,55 +113,82 @@ def LoadGraph(
     comm_spec: CommSpec,
     spec: LoadGraphSpec | None = None,
 ) -> ShardedEdgecutFragment:
-    """Entry point, mirroring `LoadGraph<FRAG_T>` (`loader.h:42-53`)."""
+    """Entry point, mirroring `LoadGraph<FRAG_T>` (`loader.h:42-53`).
+
+    With obs/ armed, the load emits a `load_graph` span with
+    `read_edges` / `partition` / `build_fragment` / `deserialize` /
+    `serialize` children — load skew shows up on the same timeline as
+    the query it delays."""
+    from libgrape_lite_tpu import obs
+
     spec = spec or LoadGraphSpec()
+    tr = obs.tracer()
 
-    cache = None
-    if (spec.serialize or spec.deserialize) and spec.serialization_prefix:
-        cache, sig = _cache_dir(efile, vfile or "", spec, comm_spec.fnum)
+    with tr.span("load_graph", efile=efile, fnum=comm_spec.fnum) as lsp:
+        cache = None
+        if (spec.serialize or spec.deserialize) and spec.serialization_prefix:
+            cache, sig = _cache_dir(efile, vfile or "", spec, comm_spec.fnum)
 
-    if spec.deserialize and cache and os.path.exists(os.path.join(cache, "sig")):
-        return _validate_load(_deserialize_fragment(cache, comm_spec, spec))
+        if spec.deserialize and cache and os.path.exists(
+            os.path.join(cache, "sig")
+        ):
+            with tr.span("deserialize", cache=cache):
+                frag = _deserialize_fragment(cache, comm_spec, spec)
+            lsp.set(path="deserialize")
+            return _validate_load(frag)
 
-    src, dst, w = read_edge_file(
-        efile, weighted=spec.weighted, string_id=spec.string_id
-    )
-    if not spec.weighted:
-        w = None
-    if vfile:
-        oids = read_vertex_file(vfile, string_id=spec.string_id)
-    else:
-        # efile-only loading (reference basic_efile_fragment_loader.h):
-        # vertex universe = the set of edge endpoints.  np.unique yields
-        # them in sorted oid order (NOT the reference's first-appearance
-        # order); lids therefore differ, but all output is oid-keyed so
-        # results are unaffected.
-        oids = np.unique(np.concatenate([src, dst]))
+        with tr.span("read_edges"):
+            src, dst, w = read_edge_file(
+                efile, weighted=spec.weighted, string_id=spec.string_id
+            )
+            if not spec.weighted:
+                w = None
+            if vfile:
+                oids = read_vertex_file(vfile, string_id=spec.string_id)
+            else:
+                # efile-only loading (basic_efile_fragment_loader.h):
+                # vertex universe = the set of edge endpoints.
+                # np.unique yields them in sorted oid order (NOT the
+                # reference's first-appearance order); lids therefore
+                # differ, but all output is oid-keyed so results are
+                # unaffected.
+                oids = np.unique(np.concatenate([src, dst]))
+        lsp.set(edges=int(len(src)), vertices=int(len(oids)))
 
-    if spec.rebalance:
-        from libgrape_lite_tpu.fragment.rebalancer import Rebalancer
+        with tr.span("partition", kind=spec.partitioner_type):
+            if spec.rebalance:
+                from libgrape_lite_tpu.fragment.rebalancer import Rebalancer
 
-        partitioner = Rebalancer(spec.rebalance_vertex_factor).partition(
-            oids, src, dst, comm_spec.fnum
-        )
-    else:
-        partitioner = make_partitioner(
-            spec.partitioner_type, comm_spec.fnum, oids
-        )
-    vm = VertexMap.build(oids, partitioner, idxer_type=spec.idxer_type)
+                partitioner = Rebalancer(
+                    spec.rebalance_vertex_factor
+                ).partition(oids, src, dst, comm_spec.fnum)
+            else:
+                partitioner = make_partitioner(
+                    spec.partitioner_type, comm_spec.fnum, oids
+                )
+            vm = VertexMap.build(
+                oids, partitioner, idxer_type=spec.idxer_type
+            )
 
-    frag = ShardedEdgecutFragment.build(
-        comm_spec, vm, src, dst, w,
-        directed=spec.directed,
-        load_strategy=spec.load_strategy,
-        vid_dtype=spec.vid_dtype,
-        edata_dtype=spec.edata_dtype,
-    )
-    frag.load_spec = spec  # preserved across rebuild-on-mutate
+        with tr.span("build_fragment"):
+            frag = ShardedEdgecutFragment.build(
+                comm_spec, vm, src, dst, w,
+                directed=spec.directed,
+                load_strategy=spec.load_strategy,
+                vid_dtype=spec.vid_dtype,
+                edata_dtype=spec.edata_dtype,
+            )
+            frag.load_spec = spec  # preserved across rebuild-on-mutate
 
-    if spec.serialize and cache:
-        _serialize_fragment(frag, cache, sig)
-    return _validate_load(frag)
+        if spec.serialize and cache:
+            with tr.span("serialize", cache=cache):
+                _serialize_fragment(frag, cache, sig)
+        if tr.enabled:
+            obs.metrics().gauge("grape_graph_edges").set(int(len(src)))
+            obs.metrics().gauge("grape_graph_vertices").set(
+                int(len(oids))
+            )
+        return _validate_load(frag)
 
 
 # ---- archive-backed cache format (utils/archive.py) ---------------------

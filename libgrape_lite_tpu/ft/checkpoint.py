@@ -37,8 +37,11 @@ import shutil
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
+import time
+
 import numpy as np
 
+from libgrape_lite_tpu import obs
 from libgrape_lite_tpu.ft.fingerprint import fingerprint_mismatch
 from libgrape_lite_tpu.utils import logging as glog
 
@@ -155,6 +158,21 @@ def restore_latest(
     (resuming a different computation is never safe); corrupt shards
     are skipped with a warning, falling back to the previous complete
     superstep."""
+    t0 = time.perf_counter()
+    with obs.tracer().span("checkpoint_restore", dir=directory) as sp:
+        state, meta = _restore_latest(directory, expected_fingerprint)
+        sp.set(round=int(meta.get("rounds", -1)))
+    m = obs.metrics()
+    m.counter("grape_checkpoint_restores_total").inc()
+    m.histogram("grape_checkpoint_restore_seconds").observe(
+        time.perf_counter() - t0
+    )
+    return state, meta
+
+
+def _restore_latest(
+    directory: str, expected_fingerprint: Dict[str, Any]
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
     steps = list_checkpoints(directory)
     if not steps:
         raise FileNotFoundError(
@@ -237,16 +255,20 @@ class CheckpointManager:
         superstep loop: device→host copies are kicked asynchronously and
         the serialization runs on the writer thread.  Waits only for the
         *previous* write (double buffer)."""
-        self.wait()
-        for v in state.values():
-            # start the D2H DMA now; np.asarray on the writer thread
-            # then completes an already-running transfer
-            if hasattr(v, "copy_to_host_async"):
-                v.copy_to_host_async()
-        snap = dict(state)
-        self._pending = self._executor.submit(
-            self._write, snap, int(rounds), int(active)
-        )
+        with obs.tracer().span("checkpoint_save", round=int(rounds)):
+            # span covers the double-buffer wait + D2H kick — the part
+            # the superstep loop actually pays; the serialization cost
+            # lands in the writer thread's checkpoint_write span
+            self.wait()
+            for v in state.values():
+                # start the D2H DMA now; np.asarray on the writer
+                # thread then completes an already-running transfer
+                if hasattr(v, "copy_to_host_async"):
+                    v.copy_to_host_async()
+            snap = dict(state)
+            self._pending = self._executor.submit(
+                self._write, snap, int(rounds), int(active)
+            )
 
     def wait(self) -> None:
         """Block until the in-flight write (if any) is durable;
@@ -260,6 +282,16 @@ class CheckpointManager:
         self._executor.shutdown(wait=True)
 
     def _write(self, state: Dict[str, Any], rounds: int, active: int):
+        t0 = time.perf_counter()
+        with obs.tracer().span("checkpoint_write", round=rounds) as sp:
+            self._write_inner(state, rounds, active, sp)
+        m = obs.metrics()
+        m.counter("grape_checkpoint_saves_total").inc()
+        m.histogram("grape_checkpoint_save_seconds").observe(
+            time.perf_counter() - t0
+        )
+
+    def _write_inner(self, state, rounds: int, active: int, sp):
         host: Dict[str, np.ndarray] = {}
         for k, v in state.items():
             a = np.asarray(v)
@@ -307,10 +339,10 @@ class CheckpointManager:
             shutil.rmtree(final, ignore_errors=True)
         os.rename(tmp, final)
         self._gc()
+        sp.set(bytes=len(blob))
         glog.vlog(
-            1,
-            f"checkpoint: superstep {rounds} -> {final} "
-            f"({len(blob)} bytes)",
+            1, "checkpoint: superstep %d -> %s (%d bytes)",
+            rounds, final, len(blob),
         )
 
     def _gc(self) -> None:

@@ -88,6 +88,14 @@ def with_retries(
             if not transient or attempt + 1 >= policy.max_attempts:
                 raise
             d = policy.delay(attempt, rng)
+            from libgrape_lite_tpu import obs
+
+            obs.metrics().counter("grape_retry_attempts_total").inc()
+            obs.tracer().instant(
+                "retry", attempt=attempt + 1,
+                of=describe or None, delay_s=round(d, 3),
+                error=f"{type(e).__name__}: {e}",
+            )
             glog.log_info(
                 f"retry {attempt + 1}/{policy.max_attempts - 1}"
                 f"{' of ' + describe if describe else ''} in {d:.2f}s "

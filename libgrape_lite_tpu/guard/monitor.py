@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from libgrape_lite_tpu import obs
 from libgrape_lite_tpu.guard.config import GuardConfig
 from libgrape_lite_tpu.guard.watchdog import (
     DivergenceWatchdog,
@@ -173,6 +174,7 @@ class GuardMonitor:
         only the invariants-only probe, or nothing at all when the app
         declares no invariants."""
         self.probes += 1
+        obs.metrics().counter("grape_guard_probes_total").inc()
         if self._probe is None:
             self._resolve(cur)
         vnum = self.frag.dev.total_vnum
@@ -242,6 +244,15 @@ class GuardMonitor:
                 failed) -> Optional[Breach]:
         bundle = self._bundle(verdict, rounds, active)
         self.breaches.append(bundle)
+        # the breach lands on the trace timeline as an instant event,
+        # so a Perfetto view shows WHICH superstep span it interrupted;
+        # the bundle carries the trace id for the reverse lookup
+        obs.metrics().counter("grape_guard_breaches_total").inc()
+        obs.tracer().instant(
+            "guard_breach", kind=verdict["kind"], round=rounds,
+            policy=self.config.policy,
+            detail=verdict.get("detail", ""),
+        )
         msg = (
             f"guard: {verdict['kind']} breach at superstep {rounds} "
             f"(policy={self.config.policy}): {verdict['detail']}"
@@ -303,10 +314,14 @@ class GuardMonitor:
         from libgrape_lite_tpu.ft.checkpoint import restore_latest
 
         self.ckpt.wait()  # an in-flight write must land before listing
-        state, meta = restore_latest(
-            self.ckpt.directory, self.ckpt.fingerprint
-        )
+        with obs.tracer().span(
+            "rollback", breach_round=breach.verdict["round"]
+        ):
+            state, meta = restore_latest(
+                self.ckpt.directory, self.ckpt.fingerprint
+            )
         self.rollbacks += 1
+        obs.metrics().counter("grape_guard_rollbacks_total").inc()
         self.paranoid = True
         self.watchdog.reset()
         glog.log_info(
@@ -350,6 +365,9 @@ class GuardMonitor:
             "verdict": dict(verdict),
             "round": rounds,
             "active": int(active),
+            # None when obs/ is disarmed; with tracing on, the id ties
+            # this bundle to the trace file's metadata block
+            "trace_id": obs.trace_id(),
             "policy": self.config.policy,
             "paranoid": self.paranoid,
             "rollbacks": self.rollbacks,

@@ -1,0 +1,66 @@
+"""obs/ — structured superstep tracing and a metrics registry.
+
+One event model unifies the signals PR 2–4 left scattered (op/byte
+ledger, guard breach bundles, ad-hoc perf_counter logs): the worker
+emits nested host spans (`peval`, `superstep`, `chunk`,
+`checkpoint_write`, ...) with a sync-before-close timing convention
+(see tracer.py), guard/ft/loader attach their events to the same
+timeline, and a `MetricsRegistry` accumulates counters/gauges/
+histograms snapshotted at query end.  Export: JSONL + Chrome
+`trace_event` JSON (Perfetto-loadable) and Prometheus-text/JSON
+metrics dumps.  docs/OBSERVABILITY.md is the user guide;
+scripts/trace_report.py renders the per-superstep table.
+
+Off by default: `obs.tracer()` returns a disabled singleton whose
+`span()` is a sub-microsecond no-op (pinned by test), and arming is a
+host-side decision invisible to jit tracing — the fused hot path's
+lowered HLO is byte-identical disarmed vs armed (pinned by test).
+
+Arming: GRAPE_TRACE=/path/trace.json, GRAPE_METRICS=/path/metrics
+(env, read once lazily), `--trace`/`--metrics` (CLI), or
+`obs.configure(...)` (API).
+"""
+
+from libgrape_lite_tpu.obs.config import (
+    METRICS_ENV,
+    TRACE_ENV,
+    armed,
+    configure,
+    flush,
+    history,
+    metrics,
+    reset,
+    trace_id,
+    tracer,
+)
+from libgrape_lite_tpu.obs.export import (
+    load_trace,
+    rollup,
+    write_chrome_trace,
+)
+from libgrape_lite_tpu.obs.metrics import (
+    NULL_METRICS,
+    MetricsRegistry,
+)
+from libgrape_lite_tpu.obs.tracer import NULL_SPAN, Span, Tracer
+
+__all__ = [
+    "METRICS_ENV",
+    "TRACE_ENV",
+    "armed",
+    "configure",
+    "flush",
+    "history",
+    "metrics",
+    "reset",
+    "trace_id",
+    "tracer",
+    "load_trace",
+    "rollup",
+    "write_chrome_trace",
+    "MetricsRegistry",
+    "NULL_METRICS",
+    "NULL_SPAN",
+    "Span",
+    "Tracer",
+]

@@ -1,0 +1,205 @@
+"""Global observability state: arming, env resolution, flushing.
+
+Off by default.  Three ways to arm:
+
+* env — `GRAPE_TRACE=/path/out.json` (Chrome trace; a JSONL twin is
+  written next to it as `out.jsonl`) and/or `GRAPE_METRICS=/path/m`
+  (writes `m.json` + `m.prom` at flush).  Resolved lazily on the first
+  `obs.tracer()` / `obs.metrics()` call, so a plain
+  `GRAPE_TRACE=t.json python -m libgrape_lite_tpu.cli ...` traces with
+  no code involvement; an `atexit` hook guarantees the files land even
+  when the driver never flushes explicitly.
+* CLI — `--trace out.json` / `--metrics out` set the same config
+  programmatically (runner.py).
+* API — `obs.configure(trace_path=..., metrics_path=...,
+  in_memory=True)`; `in_memory` arms the tracer+registry with no file
+  sink (bench.py rolls spans up from the buffer itself).
+
+The armed/disarmed decision is a host-side read; nothing here is
+visible to jit tracing, so the fused path's lowered HLO is identical
+either way (pinned by tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Optional
+
+from libgrape_lite_tpu.obs import export as _export
+from libgrape_lite_tpu.obs.metrics import (
+    NULL_METRICS,
+    MetricsRegistry,
+)
+from libgrape_lite_tpu.obs.tracer import DISABLED, Tracer
+
+TRACE_ENV = "GRAPE_TRACE"
+METRICS_ENV = "GRAPE_METRICS"
+
+_lock = threading.Lock()
+_state = {
+    "resolved": False,     # env looked at yet?
+    "tracer": DISABLED,
+    "metrics": NULL_METRICS,
+    "trace_path": None,    # Chrome JSON (raw, un-suffixed)
+    "jsonl_path": None,
+    "metrics_path": None,  # basename; .json/.prom appended
+    "in_memory": False,    # keep history with no file sinks (bench)
+    "chrome_history": [],  # full event history for whole-file rewrites
+    "atexit": False,
+}
+
+
+def _jsonl_twin(trace_path: str) -> str:
+    base, ext = os.path.splitext(trace_path)
+    return (base if ext else trace_path) + ".jsonl"
+
+
+def _rank_suffixed(path: Optional[str], rank: int,
+                   default_ext: str) -> Optional[str]:
+    if not path or not rank:
+        return path
+    base, ext = os.path.splitext(path)
+    return f"{base}.r{rank}{ext or default_ext}"
+
+
+def _sink_paths():
+    """(trace, jsonl, metrics) paths with the per-rank suffix, resolved
+    at FLUSH time: the tracer can be armed before
+    jax.distributed.initialize (the runner arms obs before CommSpec),
+    so the rank is only trustworthy once work has actually run — and
+    multi-host processes must not clobber one file."""
+    tr = _state["tracer"]
+    rank = tr.pid if tr.enabled else 0
+    return (
+        _rank_suffixed(_state["trace_path"], rank, ".json"),
+        _rank_suffixed(_state["jsonl_path"], rank, ".jsonl"),
+        (f"{_state['metrics_path']}.r{rank}"
+         if rank and _state["metrics_path"] else _state["metrics_path"]),
+    )
+
+
+def _resolve_env_locked() -> None:
+    if _state["resolved"]:
+        return
+    _state["resolved"] = True
+    trace = os.environ.get(TRACE_ENV, "")
+    metrics = os.environ.get(METRICS_ENV, "")
+    if trace or metrics:
+        _configure_locked(
+            trace_path=trace or None,
+            metrics_path=metrics or None,
+        )
+
+
+def _configure_locked(*, trace_path: Optional[str] = None,
+                      jsonl_path: Optional[str] = None,
+                      metrics_path: Optional[str] = None,
+                      in_memory: bool = False) -> None:
+    if trace_path and not jsonl_path:
+        jsonl_path = _jsonl_twin(trace_path)
+    _state["trace_path"] = trace_path
+    _state["jsonl_path"] = jsonl_path
+    _state["metrics_path"] = metrics_path
+    _state["in_memory"] = in_memory
+    _state["tracer"] = Tracer(enabled=True)
+    _state["metrics"] = MetricsRegistry()
+    _state["chrome_history"] = []
+    _state["resolved"] = True
+    if not in_memory and not _state["atexit"]:
+        _state["atexit"] = True
+        atexit.register(flush)
+
+
+def configure(*, trace_path: Optional[str] = None,
+              jsonl_path: Optional[str] = None,
+              metrics_path: Optional[str] = None,
+              in_memory: bool = False) -> Tracer:
+    """Arm observability programmatically; returns the new tracer."""
+    with _lock:
+        _configure_locked(
+            trace_path=trace_path, jsonl_path=jsonl_path,
+            metrics_path=metrics_path, in_memory=in_memory,
+        )
+        return _state["tracer"]
+
+
+def reset() -> None:
+    """Disarm and forget any env resolution (tests re-arm per case)."""
+    with _lock:
+        _state["resolved"] = False
+        _state["tracer"] = DISABLED
+        _state["metrics"] = NULL_METRICS
+        _state["trace_path"] = None
+        _state["jsonl_path"] = None
+        _state["metrics_path"] = None
+        _state["in_memory"] = False
+        _state["chrome_history"] = []
+
+
+def tracer() -> Tracer:
+    if not _state["resolved"]:
+        with _lock:
+            _resolve_env_locked()
+    return _state["tracer"]
+
+
+def metrics():
+    if not _state["resolved"]:
+        with _lock:
+            _resolve_env_locked()
+    return _state["metrics"]
+
+
+def armed() -> bool:
+    return tracer().enabled
+
+
+def trace_id() -> Optional[str]:
+    return tracer().trace_id
+
+
+def flush() -> dict:
+    """Drain buffered events to the configured sinks; returns
+    {"events": n, "trace": path|None, "jsonl": path|None,
+    "metrics": basename|None}.  Safe (and cheap) to call disarmed or
+    with no file sinks configured — bench-style in-memory users read
+    `tracer().events()` instead."""
+    tr = _state["tracer"]
+    out = {"events": 0, "trace": None, "jsonl": None, "metrics": None}
+    if not tr.enabled:
+        return out
+    drained = tr.drain()
+    out["events"] = len(drained)
+    trace_path, jsonl_path, mp = _sink_paths()
+    if jsonl_path and (drained or tr.metadata()):
+        _export.append_jsonl(tr.metadata() + drained, jsonl_path)
+        out["jsonl"] = jsonl_path
+    if trace_path or _state["in_memory"]:
+        # the chrome rewrite (and the in-memory rollup surface) needs
+        # the full history; metrics-only arming has no consumer for
+        # past events, so they are dropped after the drain instead of
+        # growing host memory without bound
+        _state["chrome_history"].extend(drained)
+    if trace_path:
+        _export.write_chrome_trace(
+            tr.metadata() + _state["chrome_history"], trace_path,
+            trace_id=tr.trace_id, anchor=tr.wall_anchor(),
+        )
+        out["trace"] = trace_path
+    if mp:
+        _state["metrics"].write(
+            json_path=mp + ".json", prom_path=mp + ".prom"
+        )
+        out["metrics"] = mp
+    return out
+
+
+def history() -> list:
+    """Every event this armed session has recorded (flushed + pending)
+    — the rollup surface for in-memory users."""
+    tr = _state["tracer"]
+    if not tr.enabled:
+        return []
+    return tr.metadata() + _state["chrome_history"] + list(tr._buf)

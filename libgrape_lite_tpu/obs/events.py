@@ -1,0 +1,101 @@
+"""Event model shared by the tracer and the exporters.
+
+One process emits a flat stream of event dicts; the schema is a strict
+subset of the Chrome `trace_event` format (the JSON Array Format's
+per-event objects), so the JSONL sink and the Chrome export are two
+serializations of the SAME records — a JSONL line re-wrapped in
+`{"traceEvents": [...]}` loads in Perfetto / `chrome://tracing`
+unchanged.
+
+Event kinds (the `ph` phase tag):
+
+* ``X`` — complete span: `ts` (start, µs) + `dur` (µs).  Nesting is
+  positional, exactly as Chrome renders it: two spans on the same
+  `(pid, tid)` row nest iff one's [ts, ts+dur) interval contains the
+  other's.  Span args carry the structured payload (round, active,
+  dispatch/device split — see tracer.Span for the timing convention).
+* ``i`` — instant: a point event (guard breaches, retries, log lines).
+* ``C`` — counter: per-round series (active vertices) render as a
+  stacked chart under the track.
+* ``M`` — metadata: `process_name` / `thread_name` rows.  The tracer
+  names each process `grape/r<rank>` and maps host threads and
+  per-fragment tracks (`frag/<fid>`) to distinct `tid` rows so a
+  multi-fragment mesh renders as parallel tracks.
+
+Timestamps are integer nanoseconds internally (`time.perf_counter_ns`,
+monotonic) and microseconds-with-remainder on export, Chrome's unit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+# tid rows: host threads count up from 0; per-fragment tracks live in
+# their own band so a late-spawned writer thread can never collide with
+# a fragment row
+FRAG_TID_BASE = 1000
+
+#: keys every exported event must carry (tests/test_obs.py pins these
+#: against the files the exporters actually write)
+CHROME_REQUIRED = ("ph", "ts", "pid", "name")
+
+
+def span_event(name: str, *, ts_ns: int, dur_ns: int, pid: int, tid: int,
+               args: Dict[str, Any] | None = None,
+               cat: str = "grape") -> Dict[str, Any]:
+    ev = {
+        "ph": "X",
+        "name": name,
+        "cat": cat,
+        "ts": ts_ns / 1000.0,
+        "dur": dur_ns / 1000.0,
+        "pid": pid,
+        "tid": tid,
+    }
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def instant_event(name: str, *, ts_ns: int, pid: int, tid: int,
+                  args: Dict[str, Any] | None = None,
+                  cat: str = "grape") -> Dict[str, Any]:
+    ev = {
+        "ph": "i",
+        "name": name,
+        "cat": cat,
+        "ts": ts_ns / 1000.0,
+        "pid": pid,
+        "tid": tid,
+        "s": "t",  # thread-scoped instant (the Chrome default draws nothing)
+    }
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def counter_event(name: str, *, ts_ns: int, pid: int, tid: int,
+                  values: Dict[str, float],
+                  cat: str = "grape") -> Dict[str, Any]:
+    return {
+        "ph": "C",
+        "name": name,
+        "cat": cat,
+        "ts": ts_ns / 1000.0,
+        "pid": pid,
+        "tid": tid,
+        "args": dict(values),
+    }
+
+
+def metadata_event(kind: str, *, pid: int, tid: int = 0,
+                   name: str) -> Dict[str, Any]:
+    """`kind` is `process_name` or `thread_name` (trace_event M args)."""
+    return {
+        "ph": "M",
+        "name": kind,
+        "ts": 0,
+        "pid": pid,
+        "tid": tid,
+        "args": {"name": name},
+    }

@@ -1,0 +1,112 @@
+"""Exporters: JSONL sink, Chrome trace_event JSON, span rollups.
+
+Both file formats serialize the SAME event dicts (obs/events.py):
+
+* JSONL — one event per line, append-mode, crash-tolerant: a killed
+  process leaves every flushed line readable.  The first line of every
+  flush batch is a `{"ph": "M"}` metadata block, so a file
+  concatenated from several queries still labels its rows.
+* Chrome JSON Object Format — `{"traceEvents": [...], ...}`, loadable
+  in Perfetto / `chrome://tracing`.  Rewritten whole on each flush
+  (the tracer keeps the full event history for it); `metadata`
+  carries the trace id and the wall-clock anchor so a timeline can be
+  correlated with external logs.
+
+`rollup()` is the in-memory consumer: per-span-name wall-time totals
+for bench.py's `obs` block and scripts/trace_report.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional
+
+
+def append_jsonl(events: Iterable[dict], path: str) -> int:
+    """Append one JSON line per event; returns the count written."""
+    n = 0
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev, sort_keys=True))
+            fh.write("\n")
+            n += 1
+    return n
+
+
+def write_chrome_trace(events: List[dict], path: str, *,
+                       trace_id: Optional[str] = None,
+                       anchor: Optional[dict] = None) -> None:
+    doc = {
+        "traceEvents": list(events),
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "producer": "libgrape-lite-tpu obs/",
+            **({"trace_id": trace_id} if trace_id else {}),
+            **({"clock_anchor": anchor} if anchor else {}),
+        },
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+        fh.write("\n")
+    os.replace(tmp, path)  # a reader never sees a half-written trace
+
+
+def load_trace(path: str) -> List[dict]:
+    """Read events back from either format (by content, not extension):
+    a JSON object with `traceEvents`, a JSON array, or JSONL."""
+    with open(path) as fh:
+        text = fh.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{") :
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError:
+            doc = None
+        if isinstance(doc, dict) and "traceEvents" in doc:
+            return list(doc["traceEvents"])
+    if stripped.startswith("["):
+        return list(json.loads(text))
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line:
+            events.append(json.loads(line))
+    return events
+
+
+def rollup(events: Iterable[dict],
+           include_frag_rows: bool = False) -> Dict[str, dict]:
+    """Per-span-name wall-time aggregation over `ph == "X"` events:
+    {name: {count, total_s, mean_s, max_s}} — the bench `obs` block
+    and the trace report's phase summary.  Per-fragment mirror rows
+    (tid >= FRAG_TID_BASE) restate the same host interval once per
+    fragment and are excluded unless asked for, so totals stay wall
+    time rather than wall × fnum."""
+    from libgrape_lite_tpu.obs.events import FRAG_TID_BASE
+
+    acc: Dict[str, dict] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        if not include_frag_rows and ev.get("tid", 0) >= FRAG_TID_BASE:
+            continue
+        name = ev.get("name", "?")
+        dur_s = float(ev.get("dur", 0)) / 1e6
+        r = acc.get(name)
+        if r is None:
+            acc[name] = {"count": 1, "total_s": dur_s, "max_s": dur_s}
+        else:
+            r["count"] += 1
+            r["total_s"] += dur_s
+            r["max_s"] = max(r["max_s"], dur_s)
+    for r in acc.values():
+        r["total_s"] = round(r["total_s"], 6)
+        r["max_s"] = round(r["max_s"], 6)
+        r["mean_s"] = round(r["total_s"] / r["count"], 6)
+    return acc

@@ -1,0 +1,276 @@
+"""Tracer: nested host spans with a disabled fast path.
+
+Two design constraints rule this file:
+
+1. **Disarmed cost is a branch, not a feature.**  Every call site in
+   the worker's superstep loop runs `tracer.span(...)` unconditionally;
+   with tracing off that call must cost well under a microsecond
+   (pinned by tests/test_obs.py::test_disabled_span_overhead_budget),
+   and the *compiled* fused path must be byte-identical to an
+   obs-less build (pinned by the lowered-HLO test) — the same
+   discipline guard/ established for guards-off.  A disabled tracer
+   therefore returns one shared no-op span object from a two-branch
+   method; no allocation, no clock read, no buffering.
+
+2. **Armed cost stays off the device path.**  Spans buffer into a
+   `collections.deque` — append is a single GIL-atomic bytecode, so
+   concurrent emitters (the superstep loop, the checkpoint writer
+   thread, a retry loop) never contend on a lock — and nothing is
+   serialized until `flush()`.
+
+Timing convention (the satellite fix for `Worker.query_stepwise`):
+JAX dispatch is asynchronous, so a naive `t1 - t0` around a jitted
+call measures only host-side enqueue for every round except the one
+that forces a host read.  A span's clock therefore stops only after
+the caller has synced on the device results (`jax.block_until_ready`
+on the full carry) — `dur` is honest wall time including device
+execution.  Callers that want the split call `span.mark("dispatched")`
+between the dispatch returning and the sync: the span then reports
+`dispatched_us` (host enqueue) and `device_wait_us` (sync wait, the
+device-execution estimate) in its args.  The first round after a
+compile still includes trace+compile time in `dispatch_us`; spans
+never try to hide that — bench-style callers warm up first.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, Optional
+
+from libgrape_lite_tpu.obs.events import (
+    FRAG_TID_BASE,
+    counter_event,
+    instant_event,
+    metadata_event,
+    span_event,
+)
+
+
+class _NullSpan:
+    """Shared no-op span: the entire disabled-tracer surface."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def mark(self, label: str) -> None:
+        pass
+
+    def set(self, **args) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One armed span; created by `Tracer.span` and closed by the
+    context manager (or an explicit `close()`)."""
+
+    __slots__ = ("_tracer", "name", "args", "tid", "t0_ns", "dur_ns",
+                 "_marks")
+
+    def __init__(self, tracer: "Tracer", name: str, tid: int,
+                 args: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self.tid = tid
+        self.t0_ns = time.perf_counter_ns()
+        self.dur_ns = 0
+        self._marks = None
+
+    def mark(self, label: str) -> None:
+        """Record a named intermediate timestamp (µs offsets land in
+        args as `<label>_us`); `dispatched` additionally yields
+        `device_wait_us` = close - mark, the device-execution estimate
+        under the sync-before-close convention."""
+        if self._marks is None:
+            self._marks = []
+        self._marks.append((label, time.perf_counter_ns()))
+
+    def set(self, **args) -> None:
+        """Attach/overwrite args (visible in the exported event)."""
+        self.args.update(args)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.args["error"] = exc_type.__name__
+        self.close()
+        return False
+
+    def close(self) -> None:
+        end = time.perf_counter_ns()
+        self.dur_ns = end - self.t0_ns
+        if self._marks:
+            for label, t in self._marks:
+                self.args[f"{label}_us"] = round((t - self.t0_ns) / 1000.0, 3)
+            last_label, last_t = self._marks[-1]
+            if last_label == "dispatched":
+                self.args["device_wait_us"] = round((end - last_t) / 1000.0, 3)
+        self._tracer._emit_span(self)
+
+
+class Tracer:
+    """Buffered per-process span/instant/counter recorder.
+
+    `enabled` is fixed at construction: the global disarmed tracer is a
+    singleton whose `span()`/`instant()`/`counter()` are two-branch
+    no-ops, and arming (obs.configure) swaps in a fresh enabled
+    instance — call sites hold no state, they re-read the global
+    through `obs.tracer()` per query."""
+
+    def __init__(self, enabled: bool = True, *, rank: int | None = None):
+        self.enabled = enabled
+        self._rank_fallback = int(rank or 0)
+        self.trace_id = uuid.uuid4().hex if enabled else None
+        self._buf = deque()  # lock-free: deque.append is GIL-atomic
+        self._meta_rows: list = []  # (tid, name) thread rows
+        self._tids: Dict[int, int] = {}
+        self._tid_counter = itertools.count()
+        self._lock = threading.Lock()  # tid registry only, never the hot path
+        self._t_anchor_ns = time.perf_counter_ns()
+        self._wall_anchor = time.time()
+
+    @property
+    def pid(self) -> int:
+        """The process rank, read LIVE on every use: the tracer can be
+        armed before `jax.distributed.initialize` lands (the runner
+        arms obs before CommSpec), and this jax build's pre-init
+        `process_id` default is 0 — indistinguishable from a final
+        single-host rank — so caching would freeze every multi-host
+        process at rank 0.  Events emitted before init carry pid 0;
+        everything from the first collective onward (all query spans)
+        carries the real rank."""
+        try:
+            from jax._src import distributed
+
+            pid = distributed.global_state.process_id
+            return int(pid) if pid is not None else self._rank_fallback
+        except Exception:
+            return self._rank_fallback
+
+    # ---- track bookkeeping ----------------------------------------------
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            with self._lock:
+                tid = self._tids.setdefault(ident, next(self._tid_counter))
+            name = threading.current_thread().name
+            self._meta_rows.append(
+                (tid, "host" if tid == 0 else name)
+            )
+        return tid
+
+    def frag_tid(self, fid: int) -> int:
+        """The per-fragment track row (named lazily on first use)."""
+        tid = FRAG_TID_BASE + int(fid)
+        if tid not in self._tids:
+            with self._lock:
+                if tid not in self._tids:
+                    self._tids[tid] = tid
+                    self._meta_rows.append((tid, f"frag/{fid}"))
+        return tid
+
+    # ---- emitters --------------------------------------------------------
+
+    def span(self, name: str, **args):
+        if not self.enabled:
+            return NULL_SPAN
+        return Span(self, name, self._tid(), args)
+
+    def _emit_span(self, span: Span) -> None:
+        self._buf.append(span_event(
+            span.name, ts_ns=span.t0_ns, dur_ns=span.dur_ns,
+            pid=self.pid, tid=span.tid,
+            args=span.args or None,
+        ))
+
+    def emit_span_raw(self, name: str, *, t0_ns: int, dur_ns: int,
+                      tid: int, **args) -> None:
+        """Re-emit a span interval on another track (the worker mirrors
+        superstep spans onto per-fragment rows: SPMD execution is
+        lockstep across the mesh, so the host wall interval IS each
+        fragment's interval)."""
+        if not self.enabled:
+            return
+        self._buf.append(span_event(
+            name, ts_ns=t0_ns, dur_ns=dur_ns, pid=self.pid, tid=tid,
+            args=args or None,
+        ))
+
+    def instant(self, name: str, **args) -> None:
+        if not self.enabled:
+            return
+        self._buf.append(instant_event(
+            name, ts_ns=time.perf_counter_ns(), pid=self.pid,
+            tid=self._tid(), args=args or None,
+        ))
+
+    def counter(self, name: str, **values) -> None:
+        if not self.enabled:
+            return
+        self._buf.append(counter_event(
+            name, ts_ns=time.perf_counter_ns(), pid=self.pid,
+            tid=self._tid(), values=values,
+        ))
+
+    # ---- draining --------------------------------------------------------
+
+    def drain(self) -> list:
+        """Pop every buffered event (metadata rows stay; they re-export
+        with every flush so partial files stay loadable)."""
+        out = []
+        while True:
+            try:
+                out.append(self._buf.popleft())
+            except IndexError:
+                return out
+
+    def events(self) -> list:
+        """Non-destructive snapshot: metadata + buffered events (test
+        and rollup surface; flush() is the draining exporter)."""
+        return self.metadata() + list(self._buf)
+
+    def metadata(self) -> list:
+        """Process/thread-name rows, built at export time so they
+        carry the CURRENT rank (see the `pid` property)."""
+        if not self.enabled:
+            return []
+        pid = self.pid
+        rows = [metadata_event(
+            "process_name", pid=pid, name=f"grape/r{pid}"
+        )]
+        rows += [
+            metadata_event("thread_name", pid=pid, tid=tid, name=name)
+            for tid, name in list(self._meta_rows)
+        ]
+        return rows
+
+    def wall_anchor(self) -> Dict[str, float]:
+        """Monotonic→wall-clock correlation for the export metadata."""
+        return {
+            "perf_counter_ns": self._t_anchor_ns,
+            "unix_time": self._wall_anchor,
+        }
+
+
+#: the module-level disarmed singleton (obs/config.py swaps the global
+#: reference; this instance is what every call site sees by default)
+DISABLED = Tracer(enabled=False)

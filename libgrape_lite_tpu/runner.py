@@ -52,6 +52,8 @@ class QueryArgs:
     resume: bool = False  # continue from the last complete checkpoint
     guard: str = ""  # guard/: breach policy ("" reads GRAPE_GUARD)
     profile: bool = False
+    trace: str = ""  # obs/: Chrome-trace output path ("" reads GRAPE_TRACE)
+    metrics: str = ""  # obs/: metrics snapshot basename (GRAPE_METRICS)
     serialize: bool = False
     deserialize: bool = False
     serialization_prefix: str = ""
@@ -102,6 +104,15 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
     if args.checkpoint_dir and not (args.checkpoint_every or args.resume):
         raise ValueError(
             "--checkpoint_dir requires --checkpoint_every (or --resume)"
+        )
+    if args.trace or args.metrics:
+        # arm obs/ BEFORE the load so the load_graph span is captured;
+        # flags win over env (configure replaces any env-armed tracer)
+        from libgrape_lite_tpu import obs
+
+        obs.configure(
+            trace_path=args.trace or None,
+            metrics_path=args.metrics or None,
         )
     name = args.application
     if args.vc and name == "pagerank":
@@ -240,4 +251,22 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
     if args.out_prefix:
         with timer.phase("print output"):
             worker.output(args.out_prefix)
+
+    from libgrape_lite_tpu import obs
+
+    if obs.armed():
+        # final flush: the worker flushes per query, but the output
+        # phase above and any post-query spans must land too
+        flushed = obs.flush()
+        from libgrape_lite_tpu.utils import logging as glog
+
+        if flushed["trace"]:
+            glog.log_info(
+                f"obs: trace -> {flushed['trace']} (JSONL twin "
+                f"{flushed['jsonl']}); open via https://ui.perfetto.dev"
+            )
+        if flushed["metrics"]:
+            glog.log_info(
+                f"obs: metrics -> {flushed['metrics']}.json / .prom"
+            )
     return worker

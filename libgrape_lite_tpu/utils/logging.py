@@ -1,26 +1,91 @@
 """glog-style leveled logging (reference uses glog VLOG throughout,
 e.g. `grape/worker/worker.h:120-139`).  Level via GRAPE_TPU_VLOG
-(default 0 = silent) or `set_vlog_level`."""
+(default 0 = silent) or `set_vlog_level`.
+
+r8 (obs/):
+
+* **lazy formatting** — `vlog(1, "round %d: %.6fs", r, dt)` defers the
+  `%` interpolation until the level check passes, so disabled levels
+  pay one int compare and nothing else (the worker's hot loop logs
+  per round; f-strings formatted-then-dropped were measurable).  The
+  f-string form still works for call sites off any hot path.
+* **rank prefix** — every line carries `r<process>` so interleaved
+  multi-host stderr is attributable (previously indistinguishable).
+  The rank comes from jax's distributed global state WITHOUT touching
+  `jax.process_index()` (which would force backend init at import
+  time); single-host runs print `r0`.
+* **thread safety** — `set_vlog_level` takes a lock (the CLI's
+  --profile bump can race the checkpoint writer thread's vlog);
+  readers stay lock-free — an int load is GIL-atomic, and the worst
+  outcome of a racy read is one line logged at the old level.
+* **tracer sink** — when obs/ is armed, every EMITTED line is also
+  recorded as a `log` instant event on the trace timeline, so vlog
+  output and spans interleave in one record (docs/OBSERVABILITY.md).
+"""
 
 from __future__ import annotations
 
 import os
 import sys
+import threading
 import time
 
 _level = int(os.environ.get("GRAPE_TPU_VLOG", "0"))
+_level_lock = threading.Lock()
 
 
 def set_vlog_level(level: int) -> None:
     global _level
-    _level = level
+    with _level_lock:
+        _level = int(level)
 
 
-def vlog(level: int, msg: str) -> None:
-    if level <= _level:
-        ts = time.strftime("%H:%M:%S")
-        print(f"[grape-tpu {ts}] {msg}", file=sys.stderr)
+def vlog_level() -> int:
+    return _level
 
 
-def log_info(msg: str) -> None:
-    print(f"[grape-tpu] {msg}", file=sys.stderr)
+def _rank() -> int:
+    """Process index, read LIVE on every emitted line: the first log
+    lines of a multi-host run can predate jax.distributed.initialize,
+    and this jax build's pre-init process_id default is 0 — caching
+    would freeze every process at r0.  The read is one attribute
+    lookup, paid only on lines that actually print."""
+    try:
+        from jax._src import distributed
+
+        pid = distributed.global_state.process_id
+        return int(pid) if pid is not None else 0
+    except Exception:
+        return 0
+
+
+def _emit(line: str, *, level: int) -> None:
+    print(line, file=sys.stderr)
+    # mirror onto the trace timeline when obs/ is armed (lazy import:
+    # logging must stay importable before/without the obs package, and
+    # obs modules themselves log through here)
+    try:
+        from libgrape_lite_tpu import obs
+
+        tr = obs.tracer()
+        if tr.enabled:
+            tr.instant("log", msg=line, level=level)
+    except Exception:
+        pass  # logging must never take down the run (incl. interp shutdown)
+
+
+def vlog(level: int, msg: str, *args) -> None:
+    """Leveled log; pass printf-style `args` for lazy formatting —
+    `vlog(1, "round %d", r)` formats only when level <= the threshold."""
+    if level > _level:
+        return
+    if args:
+        msg = msg % args
+    ts = time.strftime("%H:%M:%S")
+    _emit(f"[grape-tpu r{_rank()} {ts}] {msg}", level=level)
+
+
+def log_info(msg: str, *args) -> None:
+    if args:
+        msg = msg % args
+    _emit(f"[grape-tpu r{_rank()}] {msg}", level=0)

@@ -13,7 +13,7 @@ from typing import List, Tuple
 
 import jax
 
-_stack: List[Tuple[str, float]] = []
+_stack: List[Tuple[str, float, object]] = []
 _is_coordinator = True
 
 
@@ -24,12 +24,19 @@ def set_coordinator(flag: bool) -> None:
 
 def timer_start(name: str) -> None:
     jax.effects_barrier()
-    _stack.append((name, time.perf_counter()))
+    # phases double as trace spans when obs/ is armed, so the driver's
+    # load/run/output breakdown lands on the same timeline as the
+    # worker's superstep spans (span() is a no-op when disarmed)
+    from libgrape_lite_tpu import obs
+
+    span = obs.tracer().span(name)
+    _stack.append((name, time.perf_counter(), span))
 
 
 def timer_end() -> float:
     jax.effects_barrier()
-    name, t0 = _stack.pop()
+    name, t0, span = _stack.pop()
+    span.close()
     dt = time.perf_counter() - t0
     if _is_coordinator:
         print(f"[timer] {name}: {dt:.6f} s")

@@ -30,7 +30,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from libgrape_lite_tpu import compat
+from libgrape_lite_tpu import compat, obs
 from libgrape_lite_tpu.app.base import AppBase, StepContext
 from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
 from libgrape_lite_tpu.parallel.comm_spec import FRAG_AXIS
@@ -349,6 +349,7 @@ class Worker:
             else:
                 return self._query_guarded(mr, guard_cfg, **query_args)
 
+        tr = obs.tracer()
         if getattr(app, "host_only", False):
             # host-engine apps (irregular recursion, e.g. kclique) skip
             # the traced superstep loop entirely; iterative ones honor
@@ -358,8 +359,18 @@ class Worker:
             kwargs = dict(query_args)
             if "max_rounds" in inspect.signature(app.host_compute).parameters:
                 kwargs["max_rounds"] = mr
-            self._result_state = app.host_compute(frag, **kwargs)
-            self.rounds = getattr(app, "rounds", 0)
+            try:
+                with tr.span("query", mode="host",
+                             app=type(app).__name__) as sp:
+                    self._result_state = app.host_compute(frag, **kwargs)
+                    self.rounds = getattr(app, "rounds", 0)
+                    self._finish_query_obs(sp)
+            finally:
+                # flush in finally: a raising query must still land
+                # its spans/instants in the file sinks, not wait for
+                # the atexit hook
+                if tr.enabled:
+                    obs.flush()
             return self._result_state
 
         if hasattr(app, "collect_mutations"):
@@ -372,12 +383,83 @@ class Worker:
         eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
         carry = {k: v for k, v in state.items() if k not in eph}
         eph_part = {k: v for k, v in state.items() if k in eph}
-        out_state, rounds, active = runner(frag.dev, carry, eph_part)
-        out_state = jax.block_until_ready(out_state)
-        self.rounds = int(rounds)
-        self._terminate_code = min(0, int(active))
+        # the whole PEval+IncEval loop is one dispatch: the span's
+        # dispatch/device split is the honest granularity here (per-
+        # superstep spans need the stepwise or guarded-chunked paths)
+        try:
+            with tr.span("query", mode="fused",
+                         app=type(app).__name__) as sp:
+                out_state, rounds, active = runner(
+                    frag.dev, carry, eph_part
+                )
+                sp.mark("dispatched")
+                out_state = jax.block_until_ready(out_state)
+                self.rounds = int(rounds)
+                self._terminate_code = min(0, int(active))
+                if tr.enabled:
+                    # PEval + one IncEval per counted round, all
+                    # inside the single fused dispatch
+                    obs.metrics().counter(
+                        "grape_supersteps_total"
+                    ).inc(self.rounds + 1)
+                self._finish_query_obs(sp)
+        finally:
+            if tr.enabled:
+                obs.flush()
         self._result_state = out_state
         return out_state
+
+    def _ledger_brief(self):
+        """Scalar totals of the engaged pack ledger (the query span's
+        modeled-cost attachment: modeled ops/bytes sit next to the
+        measured wall/device time in ONE record — the side-by-side the
+        SparseP-style roofline accounting needs)."""
+        led = self.pack_ledger()
+        if not led:
+            return None
+        t = led["totals"]
+        return {
+            "edges": led["edges"],
+            "vpu_ops": t["vpu_ops"],
+            "mxu_ops": t["mxu_ops"],
+            "gather_rows": t["gather_rows"],
+            "hbm_bytes": t["hbm_bytes"],
+            "blocks": t["blocks"],
+        }
+
+    def _finish_query_obs(self, sp):
+        """Armed-query close-out: ledger totals + round count onto the
+        query span, registry roll-ups.  A no-op when obs/ is disarmed
+        (the caller passed the shared null span)."""
+        if not obs.armed():
+            return
+        sp.set(rounds=self.rounds, terminate_code=self._terminate_code)
+        led = self._ledger_brief()
+        m = obs.metrics()
+        m.counter("grape_queries_total").inc()
+        m.gauge("grape_query_rounds").set(self.rounds)
+        if led is not None:
+            sp.set(pack_ledger=led)
+            m.gauge("grape_pack_edges").set(led["edges"])
+            m.gauge("grape_pack_hbm_bytes").set(led["hbm_bytes"])
+            m.gauge("grape_pack_vpu_ops").set(led["vpu_ops"])
+            m.gauge("grape_pack_mxu_ops").set(led["mxu_ops"])
+        # guard probe/breach/rollback counts live in the counters the
+        # monitor itself maintains at the event sites — no duplicate
+        # gauges here that could disagree after an aborted query
+
+    def _mirror_superstep(self, tr, sp, rounds: int, name: str) -> None:
+        """Re-emit a closed superstep span on every per-fragment track:
+        SPMD execution is lockstep across the mesh, so the host wall
+        interval IS each fragment's interval — multi-frag meshes render
+        as parallel rows in Perfetto."""
+        if self.fragment.fnum <= 1:
+            return
+        for f in range(self.fragment.fnum):
+            tr.emit_span_raw(
+                name, t0_ns=sp.t0_ns, dur_ns=sp.dur_ns,
+                tid=tr.frag_tid(f), round=rounds, frag=f,
+            )
 
     def _query_guarded(self, mr: int, guard_cfg, **query_args):
         """Guarded-fused query: PEval once, then fused IncEval chunks
@@ -408,9 +490,8 @@ class Worker:
         )
         self._guard_monitor = monitor
         glog.vlog(
-            1,
-            f"guard: fused chunks of {guard_cfg.every} supersteps "
-            f"(policy={guard_cfg.policy})",
+            1, "guard: fused chunks of %d supersteps (policy=%s)",
+            guard_cfg.every, guard_cfg.policy,
         )
 
         def observe(prev, cur, rounds, active, digest=None,
@@ -425,29 +506,71 @@ class Worker:
                 # surviving a warn policy halts here
                 monitor.raise_breach(breach)
 
-        peval_fn = self._compile_single_step("peval", state)
-        prev = carry_of(state)
-        carry, active = jax.block_until_ready(peval_fn(frag.dev, state))
-        rounds = 0
-        observe(prev, carry, rounds, int(active))
-        chunk_fn = self._chunk_runner_for(guard_cfg.every, mr, state)
-        while int(active) > 0 and rounds < mr:
-            prev = carry
-            carry, r2, active, dig, res = jax.block_until_ready(
-                chunk_fn(frag.dev, carry, eph_part,
-                         jnp.int32(int(active)), jnp.int32(rounds))
-            )
-            rounds = int(r2)
-            # digest + residual rode out of the chunk dispatch itself;
-            # the monitor skips its own probe when the app declares no
-            # invariants, making guarded-fused probing free of extra
-            # host syncs
-            res_f = float(res)
-            observe(prev, carry, rounds, int(active),
-                    digest=tuple(int(x) for x in np.asarray(dig)),
-                    residual=None if res_f < 0 else res_f)
-        self.rounds = rounds
-        self._terminate_code = min(0, int(active))
+        tr = obs.tracer()
+        try:
+            with tr.span("query", mode="guarded-fused",
+                         app=type(app).__name__) as qsp:
+                peval_fn = self._compile_single_step("peval", state)
+                prev = carry_of(state)
+                with tr.span("peval") as sp:
+                    out = peval_fn(frag.dev, state)
+                    sp.mark("dispatched")
+                    carry, active = jax.block_until_ready(out)
+                    sp.set(active=int(active))
+                if tr.enabled:
+                    obs.metrics().counter(
+                        "grape_supersteps_total"
+                    ).inc()
+                rounds = 0
+                observe(prev, carry, rounds, int(active))
+                chunk_fn = self._chunk_runner_for(
+                    guard_cfg.every, mr, state
+                )
+                while int(active) > 0 and rounds < mr:
+                    prev = carry
+                    r0 = rounds
+                    with tr.span("chunk", start_round=r0) as sp:
+                        out = chunk_fn(frag.dev, carry, eph_part,
+                                       jnp.int32(int(active)),
+                                       jnp.int32(rounds))
+                        sp.mark("dispatched")
+                        carry, r2, active, dig, res = (
+                            jax.block_until_ready(out)
+                        )
+                        rounds = int(r2)
+                        sp.set(end_round=rounds, active=int(active))
+                    if tr.enabled:
+                        tr.counter("active_vertices", value=int(active))
+                        m = obs.metrics()
+                        # every superstep inside the chunk counts; the
+                        # active series only has chunk-BOUNDARY samples
+                        # here (the in-chunk votes never reach the
+                        # host) — documented in docs/OBSERVABILITY.md
+                        m.counter("grape_supersteps_total").inc(
+                            rounds - r0
+                        )
+                        m.series("grape_active_per_round").append(
+                            int(active)
+                        )
+                    # digest + residual rode out of the chunk dispatch
+                    # itself; the monitor skips its own probe when the
+                    # app declares no invariants, making guarded-fused
+                    # probing free of extra host syncs
+                    res_f = float(res)
+                    observe(prev, carry, rounds, int(active),
+                            digest=tuple(
+                                int(x) for x in np.asarray(dig)
+                            ),
+                            residual=None if res_f < 0 else res_f)
+                self.rounds = rounds
+                self._terminate_code = min(0, int(active))
+                self._finish_query_obs(qsp)
+        finally:
+            # flush in finally: a halt-policy breach raises out of the
+            # span context, and its guard_breach instant must still
+            # land in the file sinks, not wait for the atexit hook
+            if tr.enabled:
+                obs.flush()
         self._result_state = {**carry, **eph_part}
         return self._result_state
 
@@ -509,10 +632,48 @@ class Worker:
         (`checkpoint_every=K` snapshots the carry pytree every K
         supersteps via ft/checkpoint.py).  Slower than the fused `query`
         (host sync per round); results are identical for mutation-free
-        apps."""
+        apps.
+
+        With obs/ armed, every round emits a `superstep` span.  Timing
+        convention (documented on tracer.Span): the clock stops only
+        AFTER `jax.block_until_ready` on the round's full carry, so
+        `dur` is honest wall time; the `dispatched` mark splits it
+        into `dispatched_us` (host enqueue — inflated by trace+compile
+        on the first round) and `device_wait_us` (the device-execution
+        estimate).  Reported vlog times follow the same synced
+        interval."""
+        tr = obs.tracer()
+        if not tr.enabled:
+            return self._query_stepwise_impl(
+                max_rounds, checkpoint_every=checkpoint_every,
+                checkpoint_dir=checkpoint_dir, fault_plan=fault_plan,
+                guard=guard, _resume=_resume, **query_args,
+            )
+        try:
+            with tr.span("query", mode="stepwise",
+                         app=type(self.app).__name__) as sp:
+                out = self._query_stepwise_impl(
+                    max_rounds, checkpoint_every=checkpoint_every,
+                    checkpoint_dir=checkpoint_dir, fault_plan=fault_plan,
+                    guard=guard, _resume=_resume, **query_args,
+                )
+                self._finish_query_obs(sp)
+        finally:
+            # flush in finally: a breach/fault raising out of the loop
+            # must still land its spans + instants in the file sinks
+            obs.flush()
+        return out
+
+    def _query_stepwise_impl(self, max_rounds: int | None = None, *,
+                             checkpoint_every: int | None = None,
+                             checkpoint_dir: str | None = None,
+                             fault_plan=None, guard=None,
+                             _resume: bool = False, **query_args):
         import time
 
         from libgrape_lite_tpu.utils import logging as glog
+
+        tr = obs.tracer()
 
         app = self.app
         frag = self.fragment
@@ -608,11 +769,13 @@ class Worker:
                 )
 
         state = self._place_state(state_np)
-        led = self.pack_ledger()
+        led = self.pack_ledger() if glog.vlog_level() >= 1 else None
         if led:
             # per-stage ALU attribution for the engaged pack plan — the
             # stepwise profile's wall-clock lines read against these
-            # modeled shares (first-light playbook step 3)
+            # modeled shares (first-light playbook step 3); the whole
+            # block is gated on the level so a silent run never pays
+            # the ledger merge + string build
             t = led["totals"]
             e = max(1, led["edges"])
             stages = ", ".join(
@@ -652,9 +815,8 @@ class Worker:
                 )
                 self._guard_monitor = monitor
                 glog.vlog(
-                    1,
-                    f"guard: stepwise probes every {guard_cfg.every} "
-                    f"round(s) (policy={guard_cfg.policy})",
+                    1, "guard: stepwise probes every %d round(s) "
+                    "(policy=%s)", guard_cfg.every, guard_cfg.policy,
                 )
 
         # the monotone invariants compare against the carry of the LAST
@@ -667,19 +829,34 @@ class Worker:
             active = np.int32(resume_meta["active"])
             guard_prev = carry_of(state) if monitor is not None else None
             glog.vlog(
-                1,
-                f"resumed from superstep {rounds} "
-                f"(active={int(active)}, dir={checkpoint_dir})",
+                1, "resumed from superstep %d (active=%d, dir=%s)",
+                rounds, int(active), checkpoint_dir,
             )
+            tr.instant("resume", round=rounds, active=int(active))
         else:
             peval_fn = self._compile_single_step("peval", state)
             prev_carry = carry_of(state) if monitor is not None else None
             t0 = time.perf_counter()
-            state, active = jax.block_until_ready(peval_fn(frag.dev, state))
+            # timing convention: the clock stops only after the sync on
+            # the full carry (block_until_ready), so PEval's reported
+            # time is wall including device execution — not the async
+            # dispatch-only time a naive t1-t0 around the call measures
+            with tr.span("peval", round=0) as sp:
+                out = peval_fn(frag.dev, state)
+                sp.mark("dispatched")
+                state, active = jax.block_until_ready(out)
+                sp.set(active=int(active))
             state = {**state, **eph_vals}
             glog.vlog(
-                1, f"PEval: {time.perf_counter() - t0:.6f}s active={int(active)}"
+                1, "PEval: %.6fs active=%d",
+                time.perf_counter() - t0, int(active),
             )
+            if tr.enabled:
+                self._mirror_superstep(tr, sp, 0, "peval")
+                tr.counter("active_vertices", value=int(active))
+                m = obs.metrics()
+                m.series("grape_active_per_round").append(int(active))
+                m.counter("grape_supersteps_total").inc()
             rounds = 0
             if fault_plan is not None:
                 # injected device-state corruption lands BEFORE the
@@ -721,7 +898,8 @@ class Worker:
             migrated = app.migrate_state(old_frag, frag, host_state, fresh)
             state = self._place_state(migrated)
             inc_fn = self._compile_single_step("inceval", state)
-            glog.vlog(1, f"applied mutations after round {rounds}")
+            glog.vlog(1, "applied mutations after round %d", rounds)
+            tr.instant("apply_mutations", round=rounds)
             return state, frag, inc_fn, True
 
         if has_mutations:
@@ -740,16 +918,25 @@ class Worker:
         try:
             while int(active) > 0 and rounds < mr:
                 t0 = time.perf_counter()
-                state, active = jax.block_until_ready(
-                    inc_fn(frag.dev, state)
-                )
+                # same sync-before-clock-stop convention as PEval: the
+                # span (and the vlog line) cover dispatch + device wait
+                with tr.span("superstep", round=rounds + 1) as sp:
+                    out = inc_fn(frag.dev, state)
+                    sp.mark("dispatched")
+                    state, active = jax.block_until_ready(out)
+                    sp.set(active=int(active))
                 state = {**state, **eph_vals}
                 rounds += 1
                 glog.vlog(
-                    1,
-                    f"IncEval round {rounds}: "
-                    f"{time.perf_counter() - t0:.6f}s active={int(active)}",
+                    1, "IncEval round %d: %.6fs active=%d",
+                    rounds, time.perf_counter() - t0, int(active),
                 )
+                if tr.enabled:
+                    self._mirror_superstep(tr, sp, rounds, "superstep")
+                    tr.counter("active_vertices", value=int(active))
+                    m = obs.metrics()
+                    m.series("grape_active_per_round").append(int(active))
+                    m.counter("grape_supersteps_total").inc()
                 if fault_plan is not None:
                     # corruption lands BEFORE the probe: detection is
                     # same-round even for carries a further superstep

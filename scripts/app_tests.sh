@@ -95,4 +95,17 @@ run 2 sssp --sssp_source=6 --guard=halt; verify exact p2p-31-SSSP
 echo "== guard self-heal drill (corrupt_carry + rollback-replay) =="
 python scripts/fault_drill.py --self-heal --apps sssp,pagerank,wcc
 
+echo "== obs trace + per-superstep report (stepwise SSSP, fnum=2) =="
+run 2 sssp --sssp_source=6 --profile \
+  --trace "$OUT/trace.json" --metrics "$OUT/metrics"
+verify exact p2p-31-SSSP
+python scripts/trace_report.py "$OUT/trace.json" >/dev/null
+test -s "$OUT/trace.jsonl" && test -s "$OUT/metrics.prom"
+echo "  OK (trace + jsonl + metrics written, report rendered)"
+
+echo "== BENCH record schema (fresh small-scale bench + archived r05) =="
+GRAPE_BENCH_SCALE=10 GRAPE_BENCH_NO_PROBE=1 GRAPE_BENCH_NO_LEDGER=1 \
+  GRAPE_BENCH_NO_GUARD=1 python bench.py > "$OUT/bench.json" 2>/dev/null
+python scripts/check_bench_schema.py "$OUT/bench.json" BENCH_r05.json
+
 echo "ALL APP TESTS PASSED"

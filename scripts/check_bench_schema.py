@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Validate BENCH json records against the declared schema.
+
+The bench record is a cross-session contract: the driver reads the
+LAST json line, PERF_NOTES tables are built from the fields, and the
+r7 ledger gate compares split engine columns — a silently renamed or
+mistyped field corrupts every downstream comparison without failing
+anything.  This script makes the record shape a pinned artifact:
+
+* `SCHEMA` declares every block bench.py may emit (top-level metric,
+  `sssp`, `guard`, `pack_ledger` with the r7 vpu/mxu split fields,
+  the r8 `obs` rollup block);
+* `validate_record(record)` returns a list of human-readable errors
+  (empty = valid) — bench.py self-checks each record with it BEFORE
+  printing, and scripts/app_tests.sh validates a fresh small-scale
+  bench line end-to-end;
+* unknown top-level / block keys are errors: a new field must be
+  declared here (one line) or it is a typo.
+
+CLI: `python scripts/check_bench_schema.py FILE...` where FILE is a
+json record, a BENCH_r*.json driver wrapper (validated via its
+`parsed` field), or `-` for the last json line on stdin.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+_NUM = (int, float)
+
+# field -> (type tuple, required)
+_TOP = {
+    "metric": (str, True),
+    "value": (_NUM, True),
+    "unit": (str, True),
+    "vs_baseline": (_NUM, True),
+    "load_avg_1m": (_NUM, False),
+    "sssp": (dict, False),
+    "guard": (dict, False),
+    "pack_ledger": (dict, False),
+    "obs": (dict, False),
+}
+
+_SSSP = {
+    "metric": (str, True),
+    "value": (_NUM, True),
+    "unit": (str, True),
+    "variant": (str, True),
+    "vs_baseline": (_NUM, True),
+    "fused_pull": (bool, False),
+}
+
+_GUARD = {
+    "fused_off_s": (_NUM, True),
+    "guarded_s": (_NUM, True),
+    "guarded_overhead_pct": (_NUM, True),
+    "policy": (str, True),
+    "cadence": (int, True),
+    "probes": (int, True),
+}
+
+# the r7 split-engine columns are REQUIRED whenever the block appears:
+# a ledger without the vpu/mxu split is the pre-split format the cost
+# model can no longer recount
+_PACK_LEDGER = {
+    "vpu_ops_per_edge": (_NUM, True),
+    "mxu_elems_per_edge": (_NUM, True),
+    "gather_slots_per_edge": (_NUM, True),
+    "bytes_per_edge": (_NUM, True),
+    "per_stage_ops_per_edge": (dict, True),
+    "scan_mode": (str, True),
+    "modeled": (dict, True),
+    "ledger_recount_mismatch": (_NUM, True),
+}
+
+_OBS = {
+    "trace_id": ((str, type(None)), False),
+    "spans": (dict, True),
+}
+
+_SPAN_ROLLUP = {
+    "count": (int, True),
+    "total_s": (_NUM, True),
+    "mean_s": (_NUM, True),
+    "max_s": (_NUM, True),
+}
+
+SCHEMA = {
+    "": _TOP,
+    "sssp": _SSSP,
+    "guard": _GUARD,
+    "pack_ledger": _PACK_LEDGER,
+    "obs": _OBS,
+}
+
+
+def _check_block(block: dict, spec: dict, where: str, errors: list,
+                 allow_unknown: bool = False) -> None:
+    for field, (types, required) in spec.items():
+        if field not in block:
+            if required:
+                errors.append(f"{where}: missing required field {field!r}")
+            continue
+        v = block[field]
+        accepted = types if isinstance(types, tuple) else (types,)
+        # bool is an int subclass: every numeric field (int OR the
+        # (int, float) number tuple) must reject it explicitly
+        if isinstance(v, bool) and bool not in accepted:
+            errors.append(
+                f"{where}.{field}: expected "
+                f"{getattr(types, '__name__', types)}, got bool"
+            )
+        elif not isinstance(v, types):
+            errors.append(
+                f"{where}.{field}: expected "
+                f"{getattr(types, '__name__', types)}, got "
+                f"{type(v).__name__} ({v!r})"
+            )
+    if not allow_unknown:
+        for k in block:
+            if k not in spec:
+                errors.append(
+                    f"{where}: unknown field {k!r} — declare it in "
+                    "scripts/check_bench_schema.py or fix the typo"
+                )
+
+
+def validate_record(record) -> list:
+    """Every schema violation in one BENCH record (empty = valid)."""
+    errors: list = []
+    if not isinstance(record, dict):
+        return [f"record is {type(record).__name__}, expected object"]
+    _check_block(record, _TOP, "record", errors)
+    for key, spec in (("sssp", _SSSP), ("guard", _GUARD),
+                      ("pack_ledger", _PACK_LEDGER), ("obs", _OBS)):
+        block = record.get(key)
+        if isinstance(block, dict):
+            _check_block(block, spec, key, errors)
+    led = record.get("pack_ledger")
+    if isinstance(led, dict):
+        stages = led.get("per_stage_ops_per_edge")
+        if isinstance(stages, dict):
+            for k, v in stages.items():
+                if not isinstance(v, _NUM) or isinstance(v, bool):
+                    errors.append(
+                        f"pack_ledger.per_stage_ops_per_edge[{k!r}]: "
+                        f"expected number, got {type(v).__name__}"
+                    )
+        if led.get("scan_mode") not in (None, "mxu", "shift"):
+            errors.append(
+                f"pack_ledger.scan_mode: {led.get('scan_mode')!r} not in "
+                "('mxu', 'shift')"
+            )
+    ob = record.get("obs")
+    if isinstance(ob, dict) and isinstance(ob.get("spans"), dict):
+        for name, r in ob["spans"].items():
+            if not isinstance(r, dict):
+                errors.append(f"obs.spans[{name!r}]: expected object")
+                continue
+            _check_block(r, _SPAN_ROLLUP, f"obs.spans[{name!r}]", errors)
+    return errors
+
+
+def _records_from_text(text: str, where: str):
+    """(record, label) pairs from a file's content: a driver wrapper
+    (validated via `parsed`), a bare record, or line-delimited output
+    where the LAST json object line wins (the driver's convention)."""
+    text = text.strip()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        if "parsed" in doc and isinstance(doc["parsed"], dict):
+            return [(doc["parsed"], f"{where}:parsed")]
+        return [(doc, where)]
+    # stream mode: last parseable json-object line (bench stdout)
+    last = None
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                last = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    if last is None:
+        raise ValueError(f"{where}: no json record found")
+    return [(last, f"{where}:last-line")]
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(__doc__.strip().splitlines()[0], file=sys.stderr)
+        print("usage: check_bench_schema.py FILE... (or - for stdin)",
+              file=sys.stderr)
+        return 64
+    failed = False
+    for path in argv:
+        text = sys.stdin.read() if path == "-" else open(path).read()
+        try:
+            pairs = _records_from_text(text, path)
+        except ValueError as e:
+            print(f"FAIL {e}")
+            failed = True
+            continue
+        for record, label in pairs:
+            errors = validate_record(record)
+            if errors:
+                failed = True
+                print(f"FAIL {label}: {len(errors)} schema error(s)")
+                for e in errors:
+                    print(f"  - {e}")
+            else:
+                blocks = [k for k in ("sssp", "guard", "pack_ledger",
+                                      "obs") if k in record]
+                print(f"OK {label} ({record.get('metric')}"
+                      + (f"; blocks: {', '.join(blocks)}" if blocks
+                         else "") + ")")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

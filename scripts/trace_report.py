@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Render a per-superstep table from an obs/ trace.
+
+Reads a Chrome trace_event JSON (or its JSONL twin) produced by
+GRAPE_TRACE / --trace / obs.configure and prints:
+
+* one row per superstep (PEval = round 0): wall ms, device-wait ms
+  (the device-execution estimate under the sync-before-close
+  convention — tracer.Span), dispatch ms, active vertices, guard
+  verdicts whose instant events landed inside the round's interval;
+* the modeled pack-ledger cost attached to the enclosing query span
+  (ops/bytes per superstep — the planner's static budget, constant
+  across rounds), laid against each round's measured wall time;
+* a drift flag on any superstep whose measured/modeled ratio is
+  more than DRIFT_X (2x) away from the run's median ratio.  Modeled
+  cost is per-round constant, so the ratio is wall-time-per-modeled-
+  unit: a flagged round ran slower (or faster) than the same modeled
+  work did in the median round — the supersteps worth profiling.
+* a phase rollup (obs.rollup) for the non-superstep spans.
+
+Usage: python scripts/trace_report.py TRACE [--drift-x 2.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+DRIFT_X = 2.0
+
+
+def _fmt_ms(us):
+    return f"{us / 1000.0:10.3f}" if us is not None else f"{'-':>10}"
+
+
+def superstep_rows(events):
+    """One row per host-track peval/superstep span, in timestamp
+    order.  Rounds deliberately may REPEAT: a guard rollback-replay
+    re-executes rounds and a file can hold several queries (bench
+    warm + measured) — every execution is a real measurement, so rows
+    are never keyed/overwritten by round number."""
+    from libgrape_lite_tpu.obs.events import FRAG_TID_BASE
+
+    rows = []
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("name") not in (
+            "peval", "superstep"
+        ):
+            continue
+        if ev.get("tid", 0) >= FRAG_TID_BASE:
+            continue  # per-fragment mirrors restate the host interval
+        args = ev.get("args") or {}
+        rnd = args.get("round")
+        if rnd is None:
+            rnd = 0 if ev["name"] == "peval" else None
+        if rnd is None:
+            continue
+        rows.append({
+            "round": int(rnd),
+            "name": ev["name"],
+            "ts": float(ev["ts"]),
+            "wall_us": float(ev.get("dur", 0)),
+            "dispatch_us": args.get("dispatched_us"),
+            "device_us": args.get("device_wait_us"),
+            "active": args.get("active"),
+            "verdicts": [],
+        })
+    return sorted(rows, key=lambda r: r["ts"])
+
+
+def attach_verdicts(rows, events):
+    """Guard instants land on the row whose [ts, ts+dur) contains (or
+    last precedes) them — a probe fires after its round's sync."""
+    for ev in events:
+        if ev.get("ph") != "i" or ev.get("name") not in (
+            "guard_breach", "resume"
+        ):
+            continue
+        ts = float(ev.get("ts", 0))
+        owner = None
+        for r in rows:
+            if r["ts"] <= ts:
+                owner = r
+            else:
+                break
+        if owner is not None:
+            args = ev.get("args") or {}
+            tag = args.get("kind", ev["name"])
+            owner["verdicts"].append(str(tag))
+
+
+def query_ledger(events):
+    """The pack_ledger args of the last query span (modeled per-round
+    cost), or None."""
+    led = None
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("name") == "query":
+            args = ev.get("args") or {}
+            if "pack_ledger" in args:
+                led = args["pack_ledger"]
+    return led
+
+
+def drift_flags(rows, drift_x: float):
+    """Flag rounds whose wall-per-modeled-unit ratio is > drift_x off
+    the median.  Modeled cost is constant per round (static ledger),
+    so the ratio reduces to wall time vs the median round — but the
+    division is kept explicit so a future per-round model (active-
+    scaled ops) slots in without changing the report."""
+    walls = sorted(r["wall_us"] for r in rows if r["wall_us"] > 0)
+    if not walls:
+        return
+    median = walls[len(walls) // 2]
+    if median <= 0:
+        return
+    for r in rows:
+        ratio = r["wall_us"] / median
+        r["drift"] = ratio
+        r["flag"] = ratio > drift_x or ratio < 1.0 / drift_x
+
+
+def render(events, drift_x: float = DRIFT_X, out=sys.stdout):
+    from libgrape_lite_tpu.obs.export import rollup
+
+    rows = superstep_rows(events)
+    attach_verdicts(rows, events)
+    led = query_ledger(events)
+    print("superstep table (wall/device from synced spans; "
+          "docs/OBSERVABILITY.md):", file=out)
+    hdr = (f"{'round':>5} {'phase':>9} {'wall_ms':>10} {'disp_ms':>10} "
+           f"{'dev_ms':>10} {'active':>9} {'x_med':>6}  guard")
+    print(hdr, file=out)
+    drift_flags(rows, drift_x)
+    flagged = 0
+    for r in rows:
+        flag = "  DRIFT" if r.get("flag") else ""
+        flagged += bool(r.get("flag"))
+        verd = ",".join(r["verdicts"]) or "-"
+        act = r["active"] if r["active"] is not None else "-"
+        print(
+            f"{r['round']:>5} {r['name']:>9} {_fmt_ms(r['wall_us'])} "
+            f"{_fmt_ms(r['dispatch_us'])} {_fmt_ms(r['device_us'])} "
+            f"{act:>9} {r.get('drift', 0):>6.2f}  {verd}{flag}",
+            file=out,
+        )
+    if not rows:
+        print("  (no peval/superstep spans — fused query? the fused "
+              "path is one dispatch; use --profile / stepwise for "
+              "per-round rows)", file=out)
+    if led:
+        e = max(1, led.get("edges", 1))
+        print(
+            "\nmodeled per-round budget (pack ledger on the query "
+            f"span): {led.get('vpu_ops', 0) / e:.1f} VPU ops/edge, "
+            f"{led.get('mxu_ops', 0) / e:.1f} MXU elems/edge, "
+            f"{led.get('hbm_bytes', 0) / e:.1f} B/edge over "
+            f"{e} edges",
+            file=out,
+        )
+    if flagged:
+        print(
+            f"\n{flagged} superstep(s) drifted >{drift_x}x from the "
+            "median wall-per-modeled-unit ratio — same modeled work, "
+            "different measured time (contention, recompile, or a "
+            "frontier the static model does not see)", file=out,
+        )
+    print("\nphase rollup:", file=out)
+    for name, r in sorted(rollup(events).items(),
+                          key=lambda kv: -kv[1]["total_s"]):
+        print(
+            f"  {name:<20} n={r['count']:<4} total={r['total_s']:.4f}s "
+            f"mean={r['mean_s']:.4f}s max={r['max_s']:.4f}s", file=out,
+        )
+    return flagged
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome trace JSON or JSONL path")
+    ap.add_argument("--drift-x", type=float, default=DRIFT_X,
+                    help="ratio-vs-median threshold to flag (default 2)")
+    ns = ap.parse_args(argv)
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
+    from libgrape_lite_tpu.obs.export import load_trace
+
+    events = load_trace(ns.trace)
+    render(events, ns.drift_x)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,564 @@
+"""obs/ tests: span nesting/ordering, Chrome-trace schema validity,
+metrics snapshot for a known SSSP run, the disabled-tracer overhead
+budget, guard bundles carrying the trace id, and the armed-vs-disarmed
+lowered-HLO identity pin."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from libgrape_lite_tpu import obs
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset(monkeypatch):
+    """Every test starts disarmed with no env arming and leaves no
+    global state behind (the suite's other tests assume disarmed)."""
+    monkeypatch.delenv(obs.TRACE_ENV, raising=False)
+    monkeypatch.delenv(obs.METRICS_ENV, raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _chain_fragment(n=8, fnum=2):
+    """Undirected path 0-1-...-n-1 with unit weights: SSSP from 0
+    needs exactly n-1 propagation rounds + 1 convergence-detection
+    round, so the metrics are checkable against first principles."""
+    from tests.test_worker import build_fragment
+
+    src = np.arange(n - 1)
+    dst = np.arange(1, n)
+    w = np.ones(n - 1)
+    return build_fragment(src, dst, w, n, fnum)
+
+
+# ---- tracer core ----------------------------------------------------------
+
+
+def test_span_nesting_and_ordering():
+    tr = obs.configure(in_memory=True)
+    with tr.span("outer", a=1):
+        with tr.span("inner1"):
+            time.sleep(0.001)
+        with tr.span("inner2"):
+            time.sleep(0.001)
+    evs = [e for e in tr.events() if e["ph"] == "X"]
+    # children close before the parent -> buffer order inner1, inner2,
+    # outer; Chrome nesting is positional (interval containment)
+    assert [e["name"] for e in evs] == ["inner1", "inner2", "outer"]
+    outer = evs[2]
+    for child in evs[:2]:
+        assert outer["ts"] <= child["ts"]
+        assert child["ts"] + child["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    i1, i2 = evs[0], evs[1]
+    assert i1["ts"] + i1["dur"] <= i2["ts"]  # siblings don't overlap
+    assert outer["args"] == {"a": 1}
+
+
+def test_span_mark_dispatch_device_split():
+    tr = obs.configure(in_memory=True)
+    with tr.span("superstep") as sp:
+        time.sleep(0.002)
+        sp.mark("dispatched")
+        time.sleep(0.004)
+    ev = [e for e in tr.events() if e["ph"] == "X"][0]
+    args = ev["args"]
+    # dur ~ dispatch + device_wait; device_wait covers the post-mark
+    # sync (the device-execution estimate under the convention)
+    assert args["dispatched_us"] >= 2000
+    assert args["device_wait_us"] >= 4000
+    assert ev["dur"] >= args["dispatched_us"] + args["device_wait_us"] - 10
+
+
+def test_chrome_trace_schema_and_jsonl_twin(tmp_path):
+    from libgrape_lite_tpu.obs.events import CHROME_REQUIRED
+
+    trace = str(tmp_path / "t.json")
+    tr = obs.configure(trace_path=trace)
+    with tr.span("query", mode="test"):
+        pass
+    tr.instant("ping")
+    tr.counter("active", value=3)
+    out = obs.flush()
+    assert out["trace"] == trace
+    # the chrome file is a loadable trace_event JSON object
+    doc = json.load(open(trace))
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    assert doc["metadata"]["trace_id"] == obs.trace_id()
+    for ev in doc["traceEvents"]:
+        for key in CHROME_REQUIRED:
+            assert key in ev, f"{ev} missing {key}"
+        if ev["ph"] == "X":
+            assert "dur" in ev and "tid" in ev
+    # the JSONL twin holds the same records, one per line
+    lines = [json.loads(ln) for ln in open(out["jsonl"])]
+    assert {e["name"] for e in lines} >= {"query", "ping", "active"}
+    # load_trace reads both formats back
+    assert {e["name"] for e in obs.load_trace(trace)} == {
+        e["name"] for e in doc["traceEvents"]
+    }
+
+
+def test_disabled_span_overhead_budget():
+    """The disarmed span call must stay sub-microsecond: the worker
+    calls it unconditionally in the superstep loop, so this number IS
+    the observability tax on every untraced query.  Budget 1µs/call
+    (measured ~0.2µs); best-of-5 batches to shrug off CI noise."""
+    tr = obs.tracer()
+    assert not tr.enabled
+    n = 50_000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with tr.span("superstep"):
+                pass
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert best < 1e-6, f"disabled span costs {best * 1e9:.0f}ns > 1µs"
+
+
+def test_disabled_surface_is_inert():
+    tr = obs.tracer()
+    sp = tr.span("x", round=1)
+    sp.mark("dispatched")
+    sp.set(active=3)
+    sp.close()
+    tr.instant("i")
+    tr.counter("c", v=1)
+    assert tr.events() == []
+    assert obs.trace_id() is None
+    m = obs.metrics()
+    m.counter("x").inc()
+    m.histogram("y").observe(1.0)
+    m.series("z").append(1)
+    assert m.snapshot() == {}
+    assert obs.flush()["events"] == 0
+
+
+# ---- metrics registry -----------------------------------------------------
+
+
+def test_metrics_prometheus_and_json():
+    obs.configure(in_memory=True)
+    m = obs.metrics()
+    m.counter("grape_retry_attempts_total", help="retries").inc(2)
+    m.gauge("grape_query_rounds").set(7)
+    h = m.histogram("grape_checkpoint_save_seconds")
+    h.observe(0.003)
+    h.observe(0.2)
+    m.series("grape_active_per_round").append(5)
+    m.series("grape_active_per_round").append(0)
+    snap = m.snapshot()
+    assert snap["grape_retry_attempts_total"]["value"] == 2
+    assert snap["grape_query_rounds"]["value"] == 7
+    assert snap["grape_checkpoint_save_seconds"]["count"] == 2
+    assert snap["grape_active_per_round"]["values"] == [5, 0]
+    text = m.to_prometheus_text()
+    assert "# TYPE grape_retry_attempts_total counter" in text
+    assert "grape_retry_attempts_total 2" in text
+    assert 'grape_checkpoint_save_seconds_bucket{le="+Inf"} 2' in text
+    assert "grape_checkpoint_save_seconds_count 2" in text
+    with pytest.raises(TypeError, match="already registered"):
+        m.gauge("grape_retry_attempts_total")
+
+
+def test_metrics_snapshot_matches_known_sssp_run():
+    """An 8-vertex chain: SSSP propagates one hop per round, so the
+    run's shape is known — and the registry's round count and active
+    series must agree with the worker's own counters (the acceptance
+    cross-check against the vlog output)."""
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    obs.configure(in_memory=True)
+    frag = _chain_fragment(n=8, fnum=2)
+    w = Worker(SSSP(), frag)
+    w.query_stepwise(source=0)
+    assert w.rounds >= 3  # at least 3 IncEval rounds on an 8-chain
+    snap = obs.metrics().snapshot()
+    assert snap["grape_query_rounds"]["value"] == w.rounds
+    assert snap["grape_queries_total"]["value"] == 1
+    # PEval + one entry per IncEval round
+    series = snap["grape_active_per_round"]["values"]
+    assert len(series) == w.rounds + 1
+    assert snap["grape_supersteps_total"]["value"] == w.rounds + 1
+    assert series[0] == 1  # PEval activates the source only
+    assert series[-1] == 0  # the final round votes converged
+    # pack-ledger byte totals ride the query span + gauges whenever a
+    # pack dispatch is engaged (CPU xla runs have no ledger: both
+    # sides must agree on that too)
+    led = w.pack_ledger()
+    q = [e for e in obs.history()
+         if e.get("ph") == "X" and e.get("name") == "query"][-1]
+    if led is None:
+        assert "pack_ledger" not in (q.get("args") or {})
+        assert "grape_pack_hbm_bytes" not in snap
+    else:
+        brief = q["args"]["pack_ledger"]
+        assert brief["hbm_bytes"] == led["totals"]["hbm_bytes"]
+        assert snap["grape_pack_hbm_bytes"]["value"] == (
+            led["totals"]["hbm_bytes"]
+        )
+
+
+def test_stepwise_trace_has_superstep_spans_and_frag_rows():
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.obs.events import FRAG_TID_BASE
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    obs.configure(in_memory=True)
+    frag = _chain_fragment(n=8, fnum=2)
+    w = Worker(SSSP(), frag)
+    w.query_stepwise(source=0)
+    evs = obs.history()
+    host = [e for e in evs if e.get("ph") == "X"
+            and e["name"] == "superstep" and e["tid"] < FRAG_TID_BASE]
+    frag_rows = [e for e in evs if e.get("ph") == "X"
+                 and e["name"] == "superstep"
+                 and e["tid"] >= FRAG_TID_BASE]
+    assert len(host) == w.rounds
+    # fnum=2 -> every superstep mirrored onto two fragment tracks
+    assert len(frag_rows) == 2 * w.rounds
+    assert {e["tid"] for e in frag_rows} == {
+        FRAG_TID_BASE, FRAG_TID_BASE + 1
+    }
+    # rounds are labeled 1..rounds and each span synced before close
+    rounds = sorted(e["args"]["round"] for e in host)
+    assert rounds == list(range(1, w.rounds + 1))
+    for e in host:
+        assert "device_wait_us" in e["args"]
+        assert e["args"]["active"] >= 0
+    # rollup excludes the mirrors: superstep wall is counted once
+    roll = obs.rollup(evs)
+    assert roll["superstep"]["count"] == w.rounds
+
+
+# ---- guard integration ----------------------------------------------------
+
+
+def test_breach_bundle_carries_trace_id():
+    from libgrape_lite_tpu.guard import GuardConfig, InvariantBreachError
+    from libgrape_lite_tpu.worker.worker import Worker
+    from tests.test_guard import BadVoter, _toy_fragment
+
+    obs.configure(in_memory=True)
+    w = Worker(BadVoter(), _toy_fragment())
+    with pytest.raises(InvariantBreachError) as ei:
+        w.query_stepwise(guard=GuardConfig(policy="halt", every=1))
+    assert ei.value.bundle["trace_id"] == obs.trace_id()
+    assert obs.trace_id() is not None
+    # the breach also landed on the timeline as an instant event
+    breaches = [e for e in obs.history() if e.get("name") == "guard_breach"]
+    assert breaches and breaches[0]["args"]["kind"] == "active_range"
+    probes = obs.metrics().snapshot()["grape_guard_probes_total"]["value"]
+    assert probes >= 1
+
+
+def test_breach_flushes_to_file_sink(tmp_path):
+    """Regression: a halt-policy breach raises out of the query — the
+    guard_breach instant and the query span must still land in the
+    trace file (flush in finally), not wait for process exit."""
+    from libgrape_lite_tpu.guard import GuardConfig, InvariantBreachError
+    from libgrape_lite_tpu.worker.worker import Worker
+    from tests.test_guard import BadVoter, _toy_fragment
+
+    trace = str(tmp_path / "t.json")
+    obs.configure(trace_path=trace)
+    w = Worker(BadVoter(), _toy_fragment())
+    with pytest.raises(InvariantBreachError):
+        w.query_stepwise(guard=GuardConfig(policy="halt", every=1))
+    names = {e.get("name") for e in obs.load_trace(trace)}
+    assert "guard_breach" in names and "query" in names
+
+
+def test_guarded_fused_supersteps_total():
+    """The guarded path must count every superstep inside its chunks,
+    not one per chunk (the active series IS chunk-boundary-sampled —
+    documented)."""
+    from libgrape_lite_tpu.guard import GuardConfig
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    obs.configure(in_memory=True)
+    w = Worker(SSSP(), _chain_fragment(n=8, fnum=2))
+    w.query(source=0, guard=GuardConfig(policy="warn", every=3))
+    snap = obs.metrics().snapshot()
+    # PEval + every IncEval superstep across all chunks
+    assert snap["grape_supersteps_total"]["value"] == w.rounds + 1
+    # boundary samples only: one per chunk, not per round
+    assert len(snap["grape_active_per_round"]["values"]) < w.rounds + 1
+
+
+def test_fused_supersteps_total():
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    obs.configure(in_memory=True)
+    w = Worker(SSSP(), _chain_fragment(n=8, fnum=2))
+    w.query(source=0)
+    snap = obs.metrics().snapshot()
+    assert snap["grape_supersteps_total"]["value"] == w.rounds + 1
+
+
+def test_breach_bundle_trace_id_none_when_disarmed():
+    from libgrape_lite_tpu.guard import GuardConfig, InvariantBreachError
+    from libgrape_lite_tpu.worker.worker import Worker
+    from tests.test_guard import BadVoter, _toy_fragment
+
+    w = Worker(BadVoter(), _toy_fragment())
+    with pytest.raises(InvariantBreachError) as ei:
+        w.query_stepwise(guard=GuardConfig(policy="halt", every=1))
+    assert ei.value.bundle["trace_id"] is None
+
+
+# ---- the disarmed fused path is untouched ---------------------------------
+
+
+def test_fused_hlo_identical_armed_vs_disarmed():
+    """Arming the tracer is a host-side decision: the fused runner's
+    lowered HLO must be byte-identical with obs disarmed vs armed —
+    tracing must never change the compiled program."""
+    import jax
+
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = _chain_fragment(n=8, fnum=2)
+
+    def lowered_text():
+        w = Worker(SSSP(), frag)
+        state = w._place_state(w.app.init_state(frag, source=0))
+        eph = frozenset(getattr(w.app, "ephemeral_keys", ()) or ())
+        carry = {k: v for k, v in state.items() if k not in eph}
+        eph_part = {k: v for k, v in state.items() if k in eph}
+        runner = w._make_runner(0)(state)
+        return jax.jit(runner).lower(frag.dev, carry, eph_part).as_text()
+
+    disarmed = lowered_text()
+    obs.configure(in_memory=True)
+    armed = lowered_text()
+    assert disarmed == armed
+
+
+# ---- logging satellites ---------------------------------------------------
+
+
+def test_vlog_lazy_formatting_skips_disabled_levels():
+    from libgrape_lite_tpu.utils import logging as glog
+
+    class Explosive:
+        def __str__(self):
+            raise AssertionError("formatted a disabled log level")
+
+    old = glog.vlog_level()
+    try:
+        glog.set_vlog_level(0)
+        glog.vlog(1, "round %s", Explosive())  # must not format
+        glog.set_vlog_level(1)
+        with pytest.raises(AssertionError, match="formatted"):
+            glog.vlog(1, "round %s", Explosive())
+    finally:
+        glog.set_vlog_level(old)
+
+
+def test_log_rank_prefix_and_tracer_sink(capsys):
+    from libgrape_lite_tpu.utils import logging as glog
+
+    tr = obs.configure(in_memory=True)
+    glog.log_info("hello %d", 42)
+    err = capsys.readouterr().err
+    assert "[grape-tpu r0] hello 42" in err
+    logs = [e for e in tr.events() if e.get("name") == "log"]
+    assert logs and "hello 42" in logs[0]["args"]["msg"]
+
+
+def test_set_vlog_level_thread_safe():
+    import threading
+
+    from libgrape_lite_tpu.utils import logging as glog
+
+    old = glog.vlog_level()
+    try:
+        threads = [
+            threading.Thread(target=glog.set_vlog_level, args=(i % 3,))
+            for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert glog.vlog_level() in (0, 1, 2)
+    finally:
+        glog.set_vlog_level(old)
+
+
+# ---- scripts --------------------------------------------------------------
+
+
+def test_trace_report_renders_table(tmp_path, capsys):
+    """Acceptance: a stepwise SSSP query with GRAPE_TRACE set produces
+    a loadable Chrome trace and trace_report renders the per-superstep
+    table from it."""
+    import sys
+
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    trace = str(tmp_path / "trace.json")
+    obs.configure(trace_path=trace)
+    frag = _chain_fragment(n=8, fnum=2)
+    w = Worker(SSSP(), frag)
+    w.query_stepwise(source=0)
+    obs.flush()
+
+    sys.path.insert(0, "scripts")
+    try:
+        from trace_report import render
+    finally:
+        sys.path.pop(0)
+    events = obs.load_trace(trace)
+    render(events)
+    out = capsys.readouterr().out
+    assert "superstep table" in out
+    assert "peval" in out and "superstep" in out
+    # one table row per superstep, each with its active count
+    for r in range(1, w.rounds + 1):
+        assert f"\n{r:>5} superstep" in out
+    assert "phase rollup" in out
+
+
+def test_check_bench_schema_validates_and_rejects():
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        from check_bench_schema import validate_record
+    finally:
+        sys.path.pop(0)
+    good = {
+        "metric": "pagerank_rmat20_mteps_per_chip", "value": 100.0,
+        "unit": "MTEPS/chip", "vs_baseline": 0.03, "load_avg_1m": 0.5,
+        "sssp": {"metric": "s", "value": 1.0, "unit": "MTEPS/chip",
+                 "variant": "sssp", "vs_baseline": 0.01},
+        "pack_ledger": {
+            "vpu_ops_per_edge": 25.4, "mxu_elems_per_edge": 3.0,
+            "gather_slots_per_edge": 1.16, "bytes_per_edge": 18.8,
+            "per_stage_ops_per_edge": {"scan": 10.0}, "scan_mode": "mxu",
+            "modeled": {}, "ledger_recount_mismatch": 0.01,
+        },
+        "obs": {"trace_id": None, "spans": {
+            "query": {"count": 4, "total_s": 1.0, "mean_s": 0.25,
+                      "max_s": 0.5},
+        }},
+    }
+    assert validate_record(good) == []
+    assert any("missing required" in e
+               for e in validate_record({"metric": "m"}))
+    bad_unknown = dict(good, typo_field=1)
+    assert any("unknown field" in e for e in validate_record(bad_unknown))
+    bad_ledger = dict(good)
+    bad_ledger["pack_ledger"] = dict(
+        good["pack_ledger"], scan_mode="warp"
+    )
+    assert any("scan_mode" in e for e in validate_record(bad_ledger))
+    missing_split = dict(good)
+    missing_split["pack_ledger"] = {
+        k: v for k, v in good["pack_ledger"].items()
+        if k != "mxu_elems_per_edge"
+    }
+    assert any("mxu_elems_per_edge" in e
+               for e in validate_record(missing_split))
+
+
+def test_metrics_flush_creates_missing_directory(tmp_path):
+    """Regression: --metrics into a not-yet-existing directory must
+    not blow up the flush at query end (the jsonl/chrome sinks already
+    makedirs; the metrics writer has to as well)."""
+    mp = str(tmp_path / "deep" / "nested" / "metrics")
+    obs.configure(metrics_path=mp)
+    obs.metrics().counter("grape_queries_total").inc()
+    out = obs.flush()
+    assert out["metrics"] == mp
+    assert json.load(open(mp + ".json"))["grape_queries_total"][
+        "value"] == 1
+
+
+def test_metrics_only_arming_does_not_accumulate_history():
+    """Regression: with only a metrics sink configured the drained
+    trace events have no consumer — flush must drop them instead of
+    growing chrome_history without bound."""
+    from libgrape_lite_tpu.obs import config as obs_config
+
+    obs.configure(metrics_path=None, in_memory=False)
+    tr = obs.tracer()
+    for _ in range(10):
+        with tr.span("superstep"):
+            pass
+    obs.flush()
+    assert obs_config._state["chrome_history"] == []
+
+
+def test_schema_rejects_bool_in_numeric_fields():
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        from check_bench_schema import validate_record
+    finally:
+        sys.path.pop(0)
+    rec = {"metric": "m", "value": True, "unit": "u",
+           "vs_baseline": False}
+    errs = validate_record(rec)
+    assert any("value" in e and "bool" in e for e in errs)
+    assert any("vs_baseline" in e and "bool" in e for e in errs)
+
+
+def test_trace_report_keeps_replayed_rounds():
+    """Regression: rollback-replayed rounds (and multi-query traces)
+    repeat round numbers; each execution is a real measurement and
+    must keep its own table row."""
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        from trace_report import superstep_rows
+    finally:
+        sys.path.pop(0)
+    tr = obs.configure(in_memory=True)
+    for rnd in (1, 2, 1, 2, 3):  # breach at 2 -> replay from 1
+        with tr.span("superstep", round=rnd) as sp:
+            sp.set(active=rnd)
+    rows = superstep_rows(obs.history())
+    assert [r["round"] for r in rows] == [1, 2, 1, 2, 3]
+
+
+# ---- ft integration -------------------------------------------------------
+
+
+def test_checkpoint_spans_and_latency_metrics(tmp_path):
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    obs.configure(in_memory=True)
+    frag = _chain_fragment(n=8, fnum=2)
+    w = Worker(SSSP(), frag)
+    w.query(source=0, checkpoint_every=2, checkpoint_dir=str(tmp_path))
+    evs = obs.history()
+    saves = [e for e in evs if e.get("name") == "checkpoint_save"]
+    writes = [e for e in evs if e.get("name") == "checkpoint_write"]
+    assert saves and writes
+    assert all("bytes" in (e.get("args") or {}) for e in writes)
+    snap = obs.metrics().snapshot()
+    assert snap["grape_checkpoint_saves_total"]["value"] == len(writes)
+    assert snap["grape_checkpoint_save_seconds"]["count"] == len(writes)
+    # resume restores through an instrumented restore_latest
+    w2 = Worker(SSSP(), frag)
+    w2.resume(str(tmp_path))
+    assert any(
+        e.get("name") == "checkpoint_restore" for e in obs.history()
+    )
+    assert obs.metrics().snapshot()[
+        "grape_checkpoint_restores_total"]["value"] >= 1
