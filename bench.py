@@ -45,6 +45,14 @@ one was requested.  Every record is self-checked against
 scripts/check_bench_schema.py before printing; schema drift exits 3
 AFTER all measurements are out (ledger drift keeps exit 2).
 
+BENCH-json serve fields (r9): `serve` carries the serving-runtime
+throughput lane (serve/, docs/SERVING.md) — per app (sssp, bfs) and
+per batch size (b1/b8/b32), queries/sec with p50/p99 latency over a
+32-query single-source stream on the serve-scale RMAT twin, plus the
+admission queue's batch-size histogram.  Env knobs:
+GRAPE_BENCH_NO_SERVE=1 skips, GRAPE_BENCH_SERVE_SCALE /
+GRAPE_BENCH_SERVE_QUERIES size the lane.
+
 Baseline derivation (BASELINE.md): the reference GPU backend runs
 PageRank on soc-LiveJournal1 (68.99M directed edges) in 24.65 ms on
 8× V100 (`Performance.md:94`), i.e. 68.99e6 * 10 rounds / 0.02465 s
@@ -108,13 +116,15 @@ def _backend_alive(timeout_s: int = 150) -> bool:
         return False
 
 
-def build_bench_fragment():
+def build_bench_fragment(scale: int | None = None):
     """The bench graph + fragment, shared with scripts/seed_pack_plans.py
     so the pre-seeded plan-cache digests stay bit-identical by
     construction.  The real load path: hash-partitioned vertex map over
     the native open-addressing idxer (round 1 bypassed VertexMap with an
     identity idxer because the dict path was load-bound; the native
-    table is ~30x faster, so the bench exercises the honest path)."""
+    table is ~30x faster, so the bench exercises the honest path).
+    `scale` overrides GRAPE_BENCH_SCALE (the serve lane runs a smaller
+    twin of the same construction)."""
     from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
     from libgrape_lite_tpu.parallel.comm_spec import CommSpec
     from libgrape_lite_tpu.utils.id_parser import IdParser
@@ -125,7 +135,8 @@ def build_bench_fragment():
     )
     from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
 
-    n, src, dst = rmat_edges(SCALE, EDGE_FACTOR)
+    n, src, dst = rmat_edges(SCALE if scale is None else scale,
+                             EDGE_FACTOR)
     comm_spec = CommSpec(fnum=1)
     oids = np.arange(n, dtype=np.int64)
     part = SegmentedPartitioner(1, oids)
@@ -425,6 +436,82 @@ def main():
                 f"[bench] guard lane failed: {type(e).__name__}: {e}",
                 file=sys.stderr,
             )
+
+    # serving throughput lane (r9, ROADMAP item 1): queries/sec at
+    # fixed p99 next to MTEPS.  A session pins the graph once; a
+    # 32-query single-source stream runs at batch sizes {1, 8, 32} for
+    # SSSP and BFS — b=1 is today's one-query-at-a-time dispatch
+    # sequence, larger batches share one vmapped dispatch, and the qps
+    # ratio IS the amortization win the obs traces predicted (dispatch
+    # overhead dominates small queries).  Point queries are a
+    # small-graph story, so the lane runs its own smaller RMAT twin
+    # (GRAPE_BENCH_SERVE_SCALE, default min(SCALE, 12)): a serving
+    # fleet shards many resident graphs rather than one planet-scale
+    # one, and a b=32 lane at RMAT-20 would not fit the CPU-fallback
+    # heap.  GRAPE_BENCH_NO_SERVE=1 skips.
+    if not os.environ.get("GRAPE_BENCH_NO_SERVE"):
+        try:
+            from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+            serve_scale = int(os.environ.get(
+                "GRAPE_BENCH_SERVE_SCALE", min(SCALE, 12)))
+            n_q = int(os.environ.get("GRAPE_BENCH_SERVE_QUERIES", 32))
+            sn, ssrc, sdst, scomm, svm, sfrag = build_bench_fragment(
+                serve_scale
+            )
+            sfrag_w = build_bench_weighted_fragment(
+                ssrc, sdst, scomm, svm
+            )
+            rng_q = np.random.default_rng(5)
+            sources = [int(x) for x in rng_q.integers(0, sn, size=n_q)]
+            serve_block = {
+                "scale": serve_scale, "queries_per_app": n_q,
+            }
+            hist: dict = {}
+            for app_key, sf in (("sssp", sfrag_w), ("bfs", sfrag)):
+                app_block = {}
+                for bsz in (1, 8, 32):
+                    sess = ServeSession(
+                        sf, policy=BatchPolicy(max_batch=bsz)
+                    )
+                    # warm: compile this (app, batch-shape) runner once
+                    for s in sources[:min(bsz, n_q)]:
+                        sess.submit(app_key, {"source": s})
+                    sess.drain()
+                    sess.queue.batch_hist = {}  # hist counts measured work
+                    t0 = time.perf_counter()
+                    for s in sources:
+                        sess.submit(app_key, {"source": s})
+                    res = sess.drain()
+                    wall = time.perf_counter() - t0
+                    lat = sorted(r.latency_s for r in res)
+                    point = {
+                        "qps": round(len(res) / wall, 2),
+                        "p50_ms": round(1e3 * lat[len(lat) // 2], 3),
+                        "p99_ms": round(1e3 * lat[
+                            min(len(lat) - 1, int(len(lat) * 0.99))
+                        ], 3),
+                        "n": len(res),
+                        "ok": sum(1 for r in res if r.ok),
+                    }
+                    app_block[f"b{bsz}"] = point
+                    for k, v in sess.queue.batch_hist.items():
+                        hist[k] = hist.get(k, 0) + v
+                    print(
+                        f"[bench] serve {app_key} b{bsz}: "
+                        f"{point['qps']} q/s p99={point['p99_ms']}ms "
+                        f"({point['ok']}/{point['n']} ok)",
+                        file=sys.stderr,
+                    )
+                serve_block[app_key] = app_block
+            serve_block["batch_hist"] = {
+                str(k): v for k, v in sorted(hist.items())
+            }
+            record["serve"] = serve_block
+            _emit_record(record)
+        except Exception as e:  # the serve lane must not cost the bench
+            print(f"[bench] serve lane failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
 
     # static op-budget ledger (r6): the planner's exact per-stage ALU
     # counts at the bench geometry ride in the BENCH json, and the

@@ -127,6 +127,15 @@ class AppBase:
     # vertex-cut apps (CommSpec.mesh2d)
     mesh_kind: str = "frag"
 
+    # serve/: the query arg that varies per lane of a batched
+    # multi-source dispatch (e.g. "source" for SSSP/BFS).  When set,
+    # `init_state` must also accept a SEQUENCE of k values for that arg
+    # and return carry leaves with a leading [k] lane axis while
+    # building ephemeral leaves (pack streams, mirror tables) ONCE —
+    # shared across lanes.  None = no native vector support; the
+    # generic `init_state_batch` stacking fallback applies.
+    batch_query_key: str | None = None
+
     def custom_specs(self) -> Dict:
         """Per-key PartitionSpec overrides for state leaves that are
         neither [fnum, ...]-sharded nor replicated (e.g. SUMMA row/col
@@ -142,6 +151,39 @@ class AppBase:
 
     def init_state(self, frag, **query_args) -> Dict:
         raise NotImplementedError
+
+    def init_state_batch(self, frag, args_list) -> Dict:
+        """Initial state for k query lanes (serve/ batched dispatch):
+        carry leaves gain a leading [k] lane axis; ephemeral leaves
+        (read-only trace inputs) stay unbatched and shared.
+
+        Apps with a `batch_query_key` and lane-uniform remaining args
+        get the cheap path — ONE init_state call with the vector arg,
+        so per-query host work (pack-plan resolve, stream builds) is
+        paid once.  Everything else falls back to one init_state per
+        lane with the carry leaves stacked (lane 0's ephemeral leaves
+        are adopted for the batch: plans are deterministic per
+        fragment, so every lane builds identical streams)."""
+        if not args_list:
+            raise ValueError("init_state_batch needs at least one lane")
+        key = self.batch_query_key
+        if key is not None:
+            fixed = {k: v for k, v in args_list[0].items() if k != key}
+            if all(
+                {k: v for k, v in a.items() if k != key} == fixed
+                for a in args_list[1:]
+            ):
+                return self.init_state(
+                    frag, **fixed,
+                    **{key: [a.get(key, 0) for a in args_list]},
+                )
+        states = [self.init_state(frag, **a) for a in args_list]
+        eph = frozenset(getattr(self, "ephemeral_keys", ()) or ())
+        return {
+            k: (states[0][k] if k in eph
+                else np.stack([s[k] for s in states]))
+            for k in states[0]
+        }
 
     def peval(self, ctx: StepContext, frag: DeviceFragment, state: Dict):
         raise NotImplementedError

@@ -2,6 +2,12 @@
 
 Flag names mirror the reference gflags catalog
 (`examples/analytical_apps/flags.cc:23-69`).
+
+`python -m libgrape_lite_tpu.cli serve ...` drives the multi-query
+serving runtime instead (serve/, docs/SERVING.md): load the graph
+once, pump a scripted query stream through the admission queue with
+vmapped multi-source batching, and print one JSON summary line
+(queries, qps, p50/p99 latency, batch-size histogram).
 """
 
 from __future__ import annotations
@@ -85,10 +91,42 @@ def make_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main(argv=None):
-    ns = make_parser().parse_args(argv)
-    platform = ns.platform
-    cpu_devices = ns.cpu_devices
+def make_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="libgrape_lite_tpu serve")
+    p.add_argument("--efile", required=True)
+    p.add_argument("--vfile", default="")
+    p.add_argument("--directed", action="store_true")
+    p.add_argument("--application", default="sssp",
+                   help="app for --sources/--num_queries streams "
+                        "(--stream lines carry their own app)")
+    p.add_argument("--sources", default="",
+                   help="comma-separated source ids, one query each")
+    p.add_argument("--num_queries", type=int, default=0,
+                   help="generate N queries with sources 0..N-1 "
+                        "(used when --sources/--stream are not given)")
+    p.add_argument("--stream", default="",
+                   help="scripted stream file: one 'app source' line "
+                        "per query")
+    p.add_argument("--max_batch", type=int, default=8,
+                   help="lanes per vmapped dispatch (serve/policy.py)")
+    p.add_argument("--max_wait_ms", type=float, default=0.0,
+                   help="queue-head wait before a partial batch ships")
+    p.add_argument("--max_rounds", type=int, default=0)
+    p.add_argument("--guard", default="",
+                   choices=["", "off", "warn", "halt", "rollback"],
+                   help="per-lane guard policy (breach isolation: a "
+                        "poisoned lane fails alone)")
+    p.add_argument("--fnum", type=int, default=None)
+    p.add_argument("--string_id", action="store_true")
+    p.add_argument("--trace", default="",
+                   help="obs/ Chrome-trace path (per-query lane rows)")
+    p.add_argument("--metrics", default="")
+    p.add_argument("--platform", default="")
+    p.add_argument("--cpu_devices", type=int, default=0)
+    return p
+
+
+def _apply_platform(platform: str, cpu_devices: int) -> None:
     if cpu_devices:
         import os
 
@@ -100,6 +138,127 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", platform)
+
+
+def serve_main(argv=None):
+    """The `serve` subcommand: resident session + scripted stream."""
+    import json
+    import sys
+    import time
+
+    import numpy as np
+
+    ns = make_serve_parser().parse_args(argv)
+    _apply_platform(ns.platform, ns.cpu_devices)
+    if ns.trace or ns.metrics:
+        from libgrape_lite_tpu import obs
+
+        obs.configure(trace_path=ns.trace or None,
+                      metrics_path=ns.metrics or None)
+
+    from libgrape_lite_tpu.fragment.loader import LoadGraph, LoadGraphSpec
+    from libgrape_lite_tpu.models import APP_REGISTRY
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+    from libgrape_lite_tpu.utils import timer
+
+    # the scripted stream: (app, source) per query
+    def coerce(src):
+        if ns.string_id:
+            return src
+        try:
+            return int(src)
+        except ValueError:
+            return src
+
+    queries = []
+    if ns.stream:
+        for line in open(ns.stream):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            app_key, src = line.split()
+            queries.append((app_key, coerce(src)))
+    elif ns.sources:
+        queries = [(ns.application, coerce(s))
+                   for s in ns.sources.split(",")]
+    else:
+        queries = [(ns.application, s)
+                   for s in range(max(1, ns.num_queries))]
+    if not queries:
+        # an all-comment --stream / empty --sources: fail BEFORE the
+        # (possibly minutes-long) graph load, not on an empty latency
+        # percentile afterwards
+        sys.exit("serve: the query stream is empty")
+    for app_key, _ in queries:
+        if app_key not in APP_REGISTRY:
+            raise ValueError(f"unknown application {app_key!r}")
+
+    # one load serves every query — the point of the session
+    weighted = any(
+        getattr(APP_REGISTRY[a], "needs_edata", False) for a, _ in queries
+    )
+    spec = LoadGraphSpec(
+        directed=ns.directed, weighted=weighted,
+        string_id=ns.string_id, edata_dtype=np.float64,
+    )
+    with timer.phase("load graph"):
+        frag = LoadGraph(ns.efile, ns.vfile or None,
+                         CommSpec(fnum=ns.fnum), spec)
+
+    sess = ServeSession(
+        frag,
+        policy=BatchPolicy(max_batch=ns.max_batch,
+                           max_wait_s=ns.max_wait_ms / 1e3),
+        guard=ns.guard or None,
+    )
+    t0 = time.perf_counter()
+    for app_key, src in queries:
+        sess.submit(app_key, {"source": src},
+                    max_rounds=ns.max_rounds or None)
+    results = sess.drain()
+    wall = time.perf_counter() - t0
+
+    lat = sorted(r.latency_s for r in results)
+    ok = sum(1 for r in results if r.ok)
+    per_app: dict = {}
+    for r in results:
+        per_app[r.app_key] = per_app.get(r.app_key, 0) + 1
+    record = {
+        "queries": len(results),
+        "ok": ok,
+        "failed": len(results) - ok,
+        "wall_s": round(wall, 4),
+        "qps": round(len(results) / wall, 2) if wall > 0 else 0.0,
+        "p50_ms": round(1e3 * lat[len(lat) // 2], 3),
+        "p99_ms": round(
+            1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+        "max_batch": ns.max_batch,
+        "batch_hist": {
+            str(k): v for k, v in sorted(sess.queue.batch_hist.items())
+        },
+        "apps": per_app,
+        "cache": sess.cache_stats(),
+    }
+    print(json.dumps(record), flush=True)
+    if results and not ok:
+        print("[serve] every query failed", file=sys.stderr)
+        sys.exit(1)
+
+    from libgrape_lite_tpu import obs
+
+    if obs.armed():
+        obs.flush()
+
+
+def main(argv=None):
+    import sys
+
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:])
+    ns = make_parser().parse_args(argv)
+    _apply_platform(ns.platform, ns.cpu_devices)
     args = QueryArgs(
         **{k: v for k, v in vars(ns).items()
            if k not in ("platform", "cpu_devices")}

@@ -25,16 +25,25 @@ class BFS(ParallelAppBase):
     load_strategy = LoadStrategy.kBothOutIn
     message_strategy = MessageStrategy.kSyncOnOuterVertex
     result_format = "int"
+    batch_query_key = "source"  # serve/: [k]-source batched dispatch
 
     def init_state(self, frag, source=0):
         import os
 
-        depth = np.full((frag.fnum, frag.vp), _SENTINEL, dtype=np.int32)
         from libgrape_lite_tpu.app.base import resolve_source
 
-        pid = resolve_source(frag, source, "BFS")
-        if pid >= 0:
-            depth[pid // frag.vp, pid % frag.vp] = 0
+        # a SEQUENCE of sources builds the batched [k, fnum, vp] carry
+        # for the serve/ vmapped multi-source dispatch (ephemeral
+        # streams below are built once and shared across lanes)
+        batched = isinstance(source, (list, tuple, np.ndarray))
+        sources = list(source) if batched else [source]
+        depth = np.full((len(sources), frag.fnum, frag.vp), _SENTINEL,
+                        dtype=np.int32)
+        for b, s in enumerate(sources):
+            pid = resolve_source(frag, s, "BFS")
+            if pid >= 0:
+                depth[b, pid // frag.vp, pid % frag.vp] = 0
+        depth = depth if batched else depth[0]
         state = {"depth": depth}
         eph_entries = {}
         from libgrape_lite_tpu.parallel.mirror import resolve_mirror_plan

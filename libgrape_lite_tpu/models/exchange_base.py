@@ -44,6 +44,7 @@ def exchange_relax(oe, cand, valid, cap: int, fnum: int, vp: int, neutral):
 
 class ExchangeAppBase(AppBase):
     host_only = True  # data-dependent host loops (capacity retry, modes)
+    host_guard = True  # the host loops run guard probes (see _round_hooks)
 
     @staticmethod
     def _dist_dtype(frag):
@@ -76,3 +77,95 @@ class ExchangeAppBase(AppBase):
     def _save_cap(self, frag, cap: int) -> None:
         self.final_capacity = cap
         self._learned_cap[frag] = cap
+
+    # ---- runtime invariants + host-loop guard probes (guard/) -----------
+
+    def invariants(self, frag, state):
+        """The exchange apps' distance state is tropical-min exactly
+        like models/sssp.py: never negative (in_range(lo=0) rejects
+        NaN too) and only ever improving; +inf is the legitimate
+        unreached sentinel.  BFS variants inherit soundly — integer
+        levels carried as floats obey the same algebra.  The monitor's
+        `requires` filtering drops these for any subclass whose carry
+        has no "dist" leaf."""
+        from libgrape_lite_tpu.guard.invariants import (
+            in_range, monotone_non_increasing,
+        )
+
+        return [
+            in_range("dist", lo=0.0),
+            monotone_non_increasing("dist"),
+        ]
+
+    def _round_hooks(self, frag, carry0: dict) -> "_HostRoundHooks":
+        """Guard + fault-injection hooks for the data-dependent host
+        loop: the Worker cannot chunk a host-driven loop, so the app
+        itself probes at round boundaries (its consistent cuts).
+        Armed by Worker.query(guard=...) via `_host_guard_cfg`, or by
+        GRAPE_GUARD directly when host_compute is called standalone."""
+        return _HostRoundHooks(self, frag, carry0)
+
+
+class _HostRoundHooks:
+    """Per-query guard monitor + fault plan for a host-driven loop.
+
+    `observe(carry, rounds, active)` mirrors the stepwise worker's
+    per-round order exactly: injected corruption lands FIRST (so
+    detection is same-round), then the invariant probe (warn logs,
+    halt/rollback raise — rollback downgrades to halt, host loops have
+    no checkpoint lineage), then the remaining fault hooks (kill@K).
+    Returns the possibly-corrupted carry for the loop to adopt."""
+
+    def __init__(self, app, frag, carry0: dict):
+        from libgrape_lite_tpu.ft.faults import active_plan
+        from libgrape_lite_tpu.guard.config import GuardConfig
+
+        # the worker hands over THIS query's resolved config (a
+        # disabled one is authoritative too: guard="off" must disarm
+        # an env-armed GRAPE_GUARD); the env fallback only covers
+        # standalone host_compute calls that bypass the Worker
+        cfg = getattr(app, "_host_guard_cfg", None) or GuardConfig.resolve(
+            None
+        )
+        self.frag = frag
+        self.monitor = None
+        if cfg.enabled:
+            from libgrape_lite_tpu.guard.monitor import GuardMonitor
+
+            self.monitor = GuardMonitor(app=app, frag=frag, config=cfg)
+        app._host_guard_monitor = self.monitor
+        plan = active_plan()
+        self.plan = None if plan.is_noop() else plan
+        self._prev = dict(carry0)
+
+    @property
+    def armed(self) -> bool:
+        return self.monitor is not None or self.plan is not None
+
+    def observe(self, carry: dict, rounds: int, active: int) -> dict:
+        import jax.numpy as jnp
+
+        if self.plan is not None:
+            corrupted = self.plan.maybe_corrupt_carry(carry, rounds)
+            if corrupted is not None:
+                carry = {
+                    **carry,
+                    **{k: jnp.asarray(v) for k, v in corrupted.items()},
+                }
+        if (
+            self.monitor is not None
+            and active >= 0
+            and self.monitor.due(rounds)
+        ):
+            breach = self.monitor.check(
+                self._prev, carry, rounds, active
+            )
+            if breach is not None:
+                # no snapshot lineage in a host loop: anything
+                # surviving the warn policy halts (the monitor logs
+                # the rollback downgrade itself)
+                self.monitor.raise_breach(breach)
+            self._prev = dict(carry)
+        if self.plan is not None:
+            self.plan.on_superstep(rounds, None)
+        return carry

@@ -28,6 +28,7 @@ class SSSP(ParallelAppBase):
     message_strategy = MessageStrategy.kSyncOnOuterVertex
     result_format = "sssp_infinity"
     needs_edata = True  # double edata (run_app.cc:48-52)
+    batch_query_key = "source"  # serve/: [k]-source batched dispatch
 
     def init_state(self, frag, source=0):
         import os
@@ -45,12 +46,20 @@ class SSSP(ParallelAppBase):
         if not jax.config.jax_enable_x64:
             # honest TPU dtype: x64-off would downcast silently anyway
             dtype = np.float32
-        dist = np.full((frag.fnum, frag.vp), np.inf, dtype=dtype)
         from libgrape_lite_tpu.app.base import resolve_source
 
-        pid = resolve_source(frag, source, "SSSP")
-        if pid >= 0:
-            dist[pid // frag.vp, pid % frag.vp] = 0.0
+        # a SEQUENCE of sources builds the batched [k, fnum, vp] carry
+        # for the serve/ vmapped multi-source dispatch — the ephemeral
+        # streams below are built once and shared across lanes
+        batched = isinstance(source, (list, tuple, np.ndarray))
+        sources = list(source) if batched else [source]
+        dist = np.full((len(sources), frag.fnum, frag.vp), np.inf,
+                       dtype=dtype)
+        for b, s in enumerate(sources):
+            pid = resolve_source(frag, s, "SSSP")
+            if pid >= 0:
+                dist[b, pid // frag.vp, pid % frag.vp] = 0.0
+        dist = dist if batched else dist[0]
         # tropical pack pipeline (ops/spmv_pack.py, GRAPE_SPMV=pack):
         # min-relaxation with the f32 weight stream baked into the plan
         self._pack = None

@@ -136,6 +136,13 @@ class SSSPDelta(ExchangeAppBase):
         self.rounds = self.retries = self.buckets = 0
         limit = max_rounds if (max_rounds and max_rounds > 0) else None
         n_pend = 1 if pid >= 0 else 0
+        # guard/ft hooks at round boundaries (bucket advances and
+        # overflow retries don't complete a round — no probe there).
+        # `pending` is part of the probed carry: a bucketed round can
+        # legitimately leave dist unchanged while the near set drains,
+        # and a dist-only digest would repeat — the watchdog would
+        # mis-prove a cycle on healthy progress
+        hooks = self._round_hooks(frag, {"dist": dist, "pending": pending})
         while n_pend > 0 and (limit is None or self.rounds < limit):
             out = self._step_for(frag, cap)(
                 frag.dev, dist, pending, jnp.asarray(thr, dt)
@@ -165,6 +172,12 @@ class SSSPDelta(ExchangeAppBase):
             dist, pending = new_dist, new_pend
             n_pend = int(n_pend_d)
             self.rounds += 1
+            if hooks.armed:
+                probed = hooks.observe(
+                    {"dist": dist, "pending": pending},
+                    self.rounds, n_pend,
+                )
+                dist, pending = probed["dist"], probed["pending"]
         self._save_cap(frag, cap)
         return {"dist": dist}
 

@@ -99,6 +99,9 @@ class SSSPMsg(ExchangeAppBase):
         self.retries = 0
         limit = max_rounds if (max_rounds and max_rounds > 0) else None
         active = 1
+        # guard/ft hooks at round boundaries (the host loop's
+        # consistent cuts): invariant probes + corrupt_carry drills
+        hooks = self._round_hooks(frag, {"dist": dist})
         while active > 0 and (limit is None or self.rounds < limit):
             new_dist, new_changed, active_d, ovf = round_for(cap)(
                 frag.dev, dist, changed
@@ -112,6 +115,10 @@ class SSSPMsg(ExchangeAppBase):
             dist, changed = new_dist, new_changed
             active = int(active_d)
             self.rounds += 1
+            if hooks.armed:
+                dist = hooks.observe(
+                    {"dist": dist}, self.rounds, active
+                )["dist"]
         self._save_cap(frag, cap)
         return {"dist": dist}
 

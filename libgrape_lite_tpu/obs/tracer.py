@@ -188,6 +188,22 @@ class Tracer:
                     self._meta_rows.append((tid, f"frag/{fid}"))
         return tid
 
+    def lane_tid(self, lane: int) -> int:
+        """The per-lane track row for serve/ batched dispatches: each
+        query of a batch renders as its own Perfetto row (the lane's
+        interval IS the batch dispatch interval — attribution, not
+        measurement).  Like frag rows, lane rows restate host
+        intervals, so the span rollup excludes them."""
+        from libgrape_lite_tpu.obs.events import LANE_TID_BASE
+
+        tid = LANE_TID_BASE + int(lane)
+        if tid not in self._tids:
+            with self._lock:
+                if tid not in self._tids:
+                    self._tids[tid] = tid
+                    self._meta_rows.append((tid, f"lane/{lane}"))
+        return tid
+
     # ---- emitters --------------------------------------------------------
 
     def span(self, name: str, **args):

@@ -2418,6 +2418,18 @@ class PackDispatch:
         )
 
 
+# resolve-path counters: how often a pack resolve was served from the
+# per-fragment cache vs the on-disk plan cache vs the O(E log E)
+# planner.  serve/ pins "a session's second query performs ZERO pack
+# planning" on `planned` staying flat (tests/test_serve.py).
+PLAN_STATS = {"frag_cache_hits": 0, "disk_cache_hits": 0, "planned": 0}
+
+
+def plan_stats() -> dict:
+    """Snapshot of the resolve-path counters (copy — mutation-safe)."""
+    return dict(PLAN_STATS)
+
+
 def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
                           with_weights: bool = False,
                           direction: str = "ie",
@@ -2438,6 +2450,7 @@ def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
            mirror.uid if mirror is not None else 0, _scan_mode())
     if key in per_frag:
         mplan = per_frag[key]
+        PLAN_STATS["frag_cache_hits"] += 1
         return PackDispatch(
             mplan, "const" if frag.fnum == 1 else "state", prefix
         )
@@ -2453,7 +2466,10 @@ def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
         shards.append(shard)
 
     mplan = _load_cached_mplan(shards, frag.vp, n_cols, cfg)
-    if mplan is None:
+    if mplan is not None:
+        PLAN_STATS["disk_cache_hits"] += 1
+    else:
+        PLAN_STATS["planned"] += 1
         if mirror is not None:
             mplan = plan_pack_multi(shards, frag.vp, n_cols, cfg)
         elif frag.fnum == 1:

@@ -1,0 +1,59 @@
+"""Admission/coalescing policy for the serving runtime.
+
+The reference libgrape-lite is a library invoked once per query; the
+serving runtime (ROADMAP item 1, "millions of users") multiplexes many
+point queries over one resident graph, and this module is the ONLY
+place the batching trade-off lives: how many compatible queries may
+share one vmapped dispatch (`max_batch`), and how long the head of the
+queue may wait for batchmates before a partial batch ships
+(`max_wait_s`).  The classic serving knobs — same shape as any
+batching RPC frontend.
+
+Compatibility is structural, not heuristic: two requests coalesce only
+when they would compile to the SAME runner — same app, same
+`max_rounds` (the round limit is baked into the while_loop cond; see
+Worker._runner_for), same guard policy, and identical non-batched
+query args.  The per-lane arg (`batch_query_key`, e.g. the SSSP/BFS
+source) is the only thing allowed to vary inside a batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class BatchPolicy:
+    """Knobs of the admission queue (serve/queue.py)."""
+
+    # lanes per vmapped dispatch; 1 disables batching (every query runs
+    # the plain fused path — the bench's baseline lane)
+    max_batch: int = 8
+    # seconds the queue head may wait for batchmates; 0 = ship whatever
+    # has coalesced by the time the pump runs (scripted/offline streams
+    # drain as fast as possible)
+    max_wait_s: float = 0.0
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError(
+                f"max_batch must be >= 1, got {self.max_batch}"
+            )
+        if self.max_wait_s < 0:
+            raise ValueError(
+                f"max_wait_s must be >= 0, got {self.max_wait_s}"
+            )
+
+
+def compat_key(app_key: str, args: dict, max_rounds, guard,
+               batch_key: str | None):
+    """Hashable coalescing key: requests with equal keys may share one
+    batched dispatch.  `batch_key` (the app's per-lane query arg) is
+    excluded — it is exactly what varies across lanes; everything else
+    (app, round limit, guard policy, remaining args) must match or the
+    lanes would need different compiled runners."""
+    fixed = tuple(sorted(
+        (k, v) for k, v in args.items() if k != batch_key
+    ))
+    policy = getattr(guard, "policy", guard) or ""
+    return (app_key, max_rounds, str(policy), fixed)

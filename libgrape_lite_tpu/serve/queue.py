@@ -1,0 +1,150 @@
+"""Admission queue: accept queries, coalesce compatible ones, dispatch.
+
+Execution model: the queue is a host-side FIFO pumped by the caller
+(a scripted stream, the CLI `serve` subcommand, or bench.py's
+throughput lane) — no background thread, so results are deterministic
+and testable.  `submit` enqueues, `pump` ships at most one batch when
+the policy says it is ready (full, or the head has waited
+`max_wait_s`), `drain` pumps until empty.  FIFO order is preserved per
+compatibility class; a batch is the head request plus the next
+compatible requests in arrival order (requests BETWEEN them stay
+queued — admission never reorders within a class, and an incompatible
+head never blocks forever because `drain`/timeout forces partial
+batches).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from libgrape_lite_tpu.serve.policy import BatchPolicy
+
+_IDS = itertools.count()
+
+
+@dataclass
+class QueryRequest:
+    """One admitted query (serve/): app + args + the limits that gate
+    coalescing (policy.compat_key)."""
+
+    app_key: str
+    args: dict
+    max_rounds: Optional[int] = None
+    guard: Optional[str] = None
+    id: int = field(default_factory=lambda: next(_IDS))
+    submitted_s: float = field(default_factory=time.perf_counter)
+    result: Optional["ServeResult"] = None
+
+    @property
+    def done(self) -> bool:
+        return self.result is not None
+
+
+@dataclass
+class ServeResult:
+    """Per-query outcome: either assembled values or a structured
+    error (a guard breach bundle for poisoned lanes — batchmates of a
+    breached query complete normally, serve/batch.py isolates lanes)."""
+
+    request_id: int
+    app_key: str
+    ok: bool
+    values: Optional[np.ndarray] = None  # [fnum, vp] assembled
+    rounds: int = 0
+    terminate_code: int = 0
+    error: Optional[dict] = None  # breach bundle / failure detail
+    lane: int = 0  # position inside the dispatched batch
+    batch_size: int = 1
+    latency_s: float = 0.0  # submit -> result delivery
+
+
+class AdmissionQueue:
+    """FIFO + coalescing front of a ServeSession.
+
+    `dispatch(batch)` is the session's batched executor: it must
+    return one ServeResult per request, in batch order.  The queue
+    records a batch-size histogram — the serving bench's saturation
+    signal (all-1 bars mean the stream never coalesced)."""
+
+    def __init__(self, dispatch: Callable[[List[QueryRequest]],
+                                          List[ServeResult]],
+                 policy: BatchPolicy | None = None,
+                 compat_key: Callable[[QueryRequest], tuple] | None = None):
+        self._dispatch = dispatch
+        self.policy = policy or BatchPolicy()
+        self._compat = compat_key or (
+            lambda r: (r.app_key, r.max_rounds, r.guard or "")
+        )
+        self._pending: List[QueryRequest] = []
+        self.batch_hist: Dict[int, int] = {}
+        self.completed = 0
+
+    def submit(self, app_key: str, args: dict | None = None, *,
+               max_rounds: int | None = None,
+               guard: str | None = None) -> QueryRequest:
+        req = QueryRequest(
+            app_key=app_key, args=dict(args or {}),
+            max_rounds=max_rounds, guard=guard,
+        )
+        self._pending.append(req)
+        return req
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def _head_batch(self) -> List[QueryRequest]:
+        """The head request plus the next compatible requests in FIFO
+        order, up to max_batch lanes."""
+        head = self._pending[0]
+        key = self._compat(head)
+        batch = [head]
+        for req in self._pending[1:]:
+            if len(batch) >= self.policy.max_batch:
+                break
+            if self._compat(req) == key:
+                batch.append(req)
+        return batch
+
+    def pump(self, now: float | None = None, *,
+             force: bool = False) -> List[ServeResult]:
+        """Dispatch at most ONE batch: when it is full, when the head
+        request has waited `max_wait_s`, or when `force`d (drain).
+        Returns the delivered results ([] = nothing was ready)."""
+        if not self._pending:
+            return []
+        batch = self._head_batch()
+        if not force and len(batch) < self.policy.max_batch:
+            now = time.perf_counter() if now is None else now
+            head_wait = now - self._pending[0].submitted_s
+            if head_wait < self.policy.max_wait_s:
+                return []
+        ids = {r.id for r in batch}
+        self._pending = [r for r in self._pending if r.id not in ids]
+        results = self._dispatch(batch)
+        if len(results) != len(batch):
+            raise RuntimeError(
+                f"dispatch returned {len(results)} results for a "
+                f"{len(batch)}-lane batch"
+            )
+        t_done = time.perf_counter()
+        for req, res in zip(batch, results):
+            res.latency_s = t_done - req.submitted_s
+            req.result = res
+        self.batch_hist[len(batch)] = (
+            self.batch_hist.get(len(batch), 0) + 1
+        )
+        self.completed += len(batch)
+        return results
+
+    def drain(self) -> List[ServeResult]:
+        """Pump until the queue is empty (partial batches forced) —
+        the scripted-stream mode of the CLI `serve` subcommand."""
+        out: List[ServeResult] = []
+        while self._pending:
+            out.extend(self.pump(force=True))
+        return out

@@ -1,0 +1,270 @@
+"""ServeSession: a resident graph serving many queries.
+
+The reference libgrape-lite is a library — load, query once, exit; the
+ROADMAP north star is a service.  A session inverts the lifetime: the
+expensive per-graph artifacts are pinned ONCE and every query reuses
+them —
+
+  * the HBM-resident sharded fragment (`frag.dev` device CSRs),
+  * pack plans (ops/spmv_pack resolves through its per-fragment cache
+    + the v3 on-disk plan cache; `plan_stats()` proves the planner
+    never re-runs),
+  * compiled fused runners, keyed by (app hyperparameters, state
+    shape, max_rounds) in each app's resident Worker
+    (`Worker._runner_cache` — the session owns the workers, so the
+    cache spans queries and `runner_cache_stats` proves the second
+    query of a shape compiles nothing).
+
+Queries arrive through the AdmissionQueue (serve/queue.py), coalesce
+into vmapped multi-source batches (Worker.query_batch) under the
+BatchPolicy, and keep per-query observability: each lane gets its own
+trace track + result record, and with guards armed each lane gets its
+own monitor with breach isolation (serve/batch.py).
+
+Typical use::
+
+    sess = ServeSession(frag)
+    reqs = [sess.submit("sssp", {"source": s}) for s in sources]
+    sess.drain()                      # or pump() under a wait policy
+    values = reqs[0].result.values
+
+docs/SERVING.md is the user guide.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from libgrape_lite_tpu import obs
+from libgrape_lite_tpu.serve.policy import BatchPolicy, compat_key
+from libgrape_lite_tpu.serve.queue import (
+    AdmissionQueue,
+    QueryRequest,
+    ServeResult,
+)
+from libgrape_lite_tpu.worker.worker import Worker
+
+
+class ServeSession:
+    def __init__(self, fragment, apps: Dict | None = None,
+                 policy: BatchPolicy | None = None,
+                 guard: Optional[str] = None):
+        """`apps` maps app_key -> app factory (default: the full
+        APP_REGISTRY); `guard` is the session-default guard policy
+        (per-request `guard=` wins)."""
+        if apps is None:
+            from libgrape_lite_tpu.models import APP_REGISTRY
+
+            apps = dict(APP_REGISTRY)
+        self.fragment = fragment
+        self.apps = apps
+        self.policy = policy or BatchPolicy()
+        self.guard = guard
+        self.queue = AdmissionQueue(
+            self._dispatch, self.policy, self._compat_key
+        )
+        self._workers: Dict[str, Worker] = {}
+        self.stats = {
+            "queries": 0, "batches": 0, "failed": 0,
+            "sequential_fallbacks": 0,
+        }
+
+    # ---- resident workers -------------------------------------------------
+
+    def worker(self, app_key: str) -> Worker:
+        """The resident Worker for one app: created on first use, then
+        reused for every query — its runner cache is the session's
+        zero-recompile guarantee."""
+        w = self._workers.get(app_key)
+        if w is None:
+            if app_key not in self.apps:
+                raise ValueError(
+                    f"unknown application {app_key!r}; session serves: "
+                    f"{sorted(self.apps)}"
+                )
+            w = Worker(self.apps[app_key](), self.fragment)
+            self._workers[app_key] = w
+        return w
+
+    def cache_stats(self) -> dict:
+        """Aggregated cache counters: compiled-runner hits/misses over
+        every resident worker plus the pack resolve-path counters —
+        the numbers the zero-recompile/zero-replanning acceptance
+        asserts on."""
+        from libgrape_lite_tpu.ops.spmv_pack import plan_stats
+
+        runner = {"hits": 0, "misses": 0}
+        for w in self._workers.values():
+            runner["hits"] += w.runner_cache_stats["hits"]
+            runner["misses"] += w.runner_cache_stats["misses"]
+        return {"runner": runner, "pack": plan_stats()}
+
+    # ---- admission --------------------------------------------------------
+
+    def _compat_key(self, req: QueryRequest) -> tuple:
+        # an unknown app must not raise here: the queue calls this
+        # while PICKING the next batch, and a raise would wedge the
+        # head of the queue forever — the dispatch path turns the
+        # lookup failure into per-request error results instead
+        if req.app_key not in self.apps:
+            return (req.app_key, "?unknown")
+        app_cls = type(self.worker(req.app_key).app)
+        return compat_key(
+            req.app_key, req.args, req.max_rounds,
+            req.guard or self.guard,
+            getattr(app_cls, "batch_query_key", None),
+        )
+
+    def submit(self, app_key: str, args: dict | None = None, *,
+               max_rounds: int | None = None,
+               guard: str | None = None) -> QueryRequest:
+        return self.queue.submit(
+            app_key, args, max_rounds=max_rounds, guard=guard
+        )
+
+    def pump(self, **kw) -> List[ServeResult]:
+        return self.queue.pump(**kw)
+
+    def drain(self) -> List[ServeResult]:
+        return self.queue.drain()
+
+    def serve(self, stream) -> List[ServeResult]:
+        """Scripted-stream convenience: submit every item, drain, and
+        return results in completion order.  Items are (app_key, args)
+        pairs or {"app": ..., "args": {...}, "max_rounds": ...,
+        "guard": ...} dicts — the CLI `serve` subcommand's format."""
+        for item in stream:
+            if isinstance(item, dict):
+                self.submit(
+                    item["app"], item.get("args"),
+                    max_rounds=item.get("max_rounds"),
+                    guard=item.get("guard"),
+                )
+            else:
+                app_key, args = item
+                self.submit(app_key, args)
+        return self.drain()
+
+    # ---- dispatch ---------------------------------------------------------
+
+    def _dispatch(self, batch: List[QueryRequest]) -> List[ServeResult]:
+        """Run one coalesced batch: a single query through the plain
+        fused path, several through the vmapped batched runner (guarded
+        or not), with a sequential fallback for apps that cannot batch
+        (host-only loops, mutation apps).  Per-request outcomes never
+        raise out of the serve loop — failures become error results."""
+        self.stats["batches"] += 1
+        self.stats["queries"] += len(batch)
+        try:
+            w = self.worker(batch[0].app_key)
+        except ValueError as e:
+            # unknown app: fail these requests, keep the loop serving
+            self.stats["failed"] += len(batch)
+            return [
+                ServeResult(
+                    request_id=req.id, app_key=req.app_key, ok=False,
+                    error={"error": str(e)}, lane=b,
+                    batch_size=len(batch),
+                )
+                for b, req in enumerate(batch)
+            ]
+        guard = batch[0].guard or self.guard
+        mr = batch[0].max_rounds
+        tr = obs.tracer()
+
+        if len(batch) > 1:
+            try:
+                w._check_batchable()
+            except ValueError:
+                self.stats["sequential_fallbacks"] += 1
+                return [
+                    r for req in batch
+                    for r in [self._run_single(w, req, guard)]
+                ]
+            with tr.span("serve_batch", app=batch[0].app_key,
+                         batch=len(batch)) as sp:
+                results = self._run_batched(w, batch, mr, guard)
+            if tr.enabled:
+                # one track per query: the lane's interval IS the batch
+                # dispatch interval, tagged with its request id so the
+                # timeline stays attributable after coalescing
+                for b, (req, res) in enumerate(zip(batch, results)):
+                    tr.emit_span_raw(
+                        "serve_query", t0_ns=sp.t0_ns,
+                        dur_ns=sp.dur_ns, tid=tr.lane_tid(b),
+                        query_id=req.id, app=req.app_key, lane=b,
+                        rounds=res.rounds, ok=res.ok,
+                    )
+            return results
+
+        with tr.span("serve_batch", app=batch[0].app_key, batch=1) as sp:
+            res = self._run_single(w, batch[0], guard)
+        if tr.enabled:
+            tr.emit_span_raw(
+                "serve_query", t0_ns=sp.t0_ns, dur_ns=sp.dur_ns,
+                tid=tr.lane_tid(0), query_id=batch[0].id,
+                app=batch[0].app_key, lane=0, rounds=res.rounds,
+                ok=res.ok,
+            )
+        return [res]
+
+    def _run_single(self, w: Worker, req: QueryRequest,
+                    guard) -> ServeResult:
+        from libgrape_lite_tpu.guard.monitor import GuardError
+
+        try:
+            w.query(req.max_rounds, guard=guard, **req.args)
+            return ServeResult(
+                request_id=req.id, app_key=req.app_key, ok=True,
+                values=w.result_values(), rounds=w.rounds,
+                terminate_code=w._terminate_code, batch_size=1,
+            )
+        except GuardError as e:
+            self.stats["failed"] += 1
+            return ServeResult(
+                request_id=req.id, app_key=req.app_key, ok=False,
+                error=e.bundle, rounds=w.rounds, batch_size=1,
+            )
+        except Exception as e:  # one bad query must not kill the loop
+            self.stats["failed"] += 1
+            return ServeResult(
+                request_id=req.id, app_key=req.app_key, ok=False,
+                error={"error": f"{type(e).__name__}: {e}"},
+                batch_size=1,
+            )
+
+    def _run_batched(self, w: Worker, batch: List[QueryRequest],
+                     mr, guard) -> List[ServeResult]:
+        try:
+            w.query_batch(
+                [req.args for req in batch], mr, guard=guard
+            )
+        except Exception as e:  # whole-batch failure: every lane errors
+            self.stats["failed"] += len(batch)
+            return [
+                ServeResult(
+                    request_id=req.id, app_key=req.app_key, ok=False,
+                    error={"error": f"{type(e).__name__}: {e}"},
+                    lane=b, batch_size=len(batch),
+                )
+                for b, req in enumerate(batch)
+            ]
+        results = []
+        breaches = w.batch_breaches or [None] * len(batch)
+        for b, req in enumerate(batch):
+            if breaches[b] is not None:
+                self.stats["failed"] += 1
+                results.append(ServeResult(
+                    request_id=req.id, app_key=req.app_key, ok=False,
+                    error=breaches[b], rounds=int(w.batch_rounds[b]),
+                    lane=b, batch_size=len(batch),
+                ))
+            else:
+                results.append(ServeResult(
+                    request_id=req.id, app_key=req.app_key, ok=True,
+                    values=w.batch_result_values(b),
+                    rounds=int(w.batch_rounds[b]),
+                    terminate_code=int(w.batch_terminate[b]),
+                    lane=b, batch_size=len(batch),
+                ))
+        return results

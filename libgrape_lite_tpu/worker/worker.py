@@ -26,6 +26,7 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
+import jax.tree_util as jtu
 import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -47,6 +48,20 @@ def _squeeze_state(state, squeezed):
 def _unsqueeze_state(state, squeezed):
     return {
         k: (v[None] if k in squeezed else v) for k, v in state.items()
+    }
+
+
+def _squeeze_lane_state(state, squeezed):
+    """Per-shard view of batched carry leaves: the lane axis leads, so
+    sharded keys arrive as [B, 1, ...] blocks and squeeze axis 1."""
+    return {
+        k: (v[:, 0] if k in squeezed else v) for k, v in state.items()
+    }
+
+
+def _unsqueeze_lane_state(state, squeezed):
+    return {
+        k: (v[:, None] if k in squeezed else v) for k, v in state.items()
     }
 
 
@@ -74,10 +89,17 @@ class Worker:
         self.fragment = fragment
         self.comm_spec = fragment.comm_spec
         self._runner_cache = {}
+        # hit/miss counters over the compiled-runner cache: serve/ pins
+        # "a session's second query triggers zero XLA compilation" on
+        # the miss count staying flat (tests/test_serve.py)
+        self.runner_cache_stats = {"hits": 0, "misses": 0}
         self.rounds = 0
         self._result_state = None
         self._terminate_code = 0
         self._guard_monitor = None  # guard/: set only while guards are armed
+        self.batch_rounds = None  # per-lane rounds of the last query_batch
+        self.batch_terminate = None  # per-lane terminate codes (min(0, v))
+        self.batch_breaches = None  # per-lane guard bundles (serve/batch)
 
     @property
     def guard_report(self):
@@ -274,30 +296,388 @@ class Worker:
 
         return compile_for
 
+    def _cached_runner(self, key, build):
+        """One compiled-runner cache lookup with hit/miss accounting
+        (serve/ asserts zero-recompile reuse through these counters)."""
+        hit = key in self._runner_cache
+        self.runner_cache_stats["hits" if hit else "misses"] += 1
+        if not hit:
+            self._runner_cache[key] = build()
+        return self._runner_cache[key]
+
+    def _state_struct(self, state):
+        return tuple(
+            sorted((k, v.shape, str(v.dtype)) for k, v in state.items())
+        )
+
     def _chunk_runner_for(self, chunk: int, max_rounds: int, state):
         key = (
             "chunk", chunk, max_rounds,
             self.app.trace_key(),
-            tuple(sorted((k, v.shape, str(v.dtype)) for k, v in state.items())),
+            self._state_struct(state),
         )
-        if key not in self._runner_cache:
-            self._runner_cache[key] = self._make_chunk_runner(
-                chunk, max_rounds
-            )(state)
-        return self._runner_cache[key]
+        return self._cached_runner(
+            key, lambda: self._make_chunk_runner(chunk, max_rounds)(state)
+        )
 
     def _runner_for(self, max_rounds: int, state):
         """Cache the jitted runner per (max_rounds, app hyperparameters,
         state structure) so repeated queries don't re-trace but changed
-        query params (which are baked into the trace) do."""
+        query params (which are baked into the trace) do.  `max_rounds`
+        is part of the key because the round limit is baked into the
+        while_loop cond — a second query with a different limit must
+        not silently reuse the first compile (pinned by
+        tests/test_worker.py::test_runner_cache_keys_max_rounds)."""
         key = (
             max_rounds,
             self.app.trace_key(),
-            tuple(sorted((k, v.shape, str(v.dtype)) for k, v in state.items())),
+            self._state_struct(state),
         )
-        if key not in self._runner_cache:
-            self._runner_cache[key] = self._make_runner(max_rounds)(state)
-        return self._runner_cache[key]
+        return self._cached_runner(
+            key, lambda: self._make_runner(max_rounds)(state)
+        )
+
+    # ---- batched multi-source execution (serve/) -------------------------
+
+    def _check_batchable(self):
+        """Batched dispatch covers superstep apps on the 1-D frag mesh;
+        everything else fails loudly BEFORE a cryptic trace error."""
+        app = self.app
+        if getattr(app, "host_only", False):
+            raise ValueError(
+                f"{type(app).__name__} is a host-only app: its "
+                "data-dependent host loop has no superstep carry to vmap"
+            )
+        if hasattr(app, "collect_mutations"):
+            raise ValueError(
+                "MutationContext apps rebuild the fragment between "
+                "rounds and cannot share one batched dispatch"
+            )
+        if app.mesh_kind != "frag":
+            raise ValueError(
+                f"batched dispatch supports the 1-D frag mesh only "
+                f"(app mesh_kind={app.mesh_kind!r})"
+            )
+        if app.custom_specs():
+            raise ValueError(
+                "batched dispatch does not support custom-spec state "
+                "leaves"
+            )
+
+    def _key_specs_batch(self, state):
+        """(spec per key, keys squeezed of their axis-1 frag dim) for a
+        batched carry: sharded leaves are [B, fnum, ...] split on axis
+        1, replicated leaves [B, ...] everywhere, ephemeral leaves stay
+        unbatched [fnum, ...] (shared streams)."""
+        app = self.app
+        replicated = set(app.replicated_keys)
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        specs, squeezed = {}, set()
+        for k in state:
+            if k in eph:
+                specs[k] = P(FRAG_AXIS)
+            elif k in replicated:
+                specs[k] = P()
+            else:
+                specs[k] = P(None, FRAG_AXIS)
+                squeezed.add(k)
+        return specs, squeezed
+
+    def _place_state_batch(self, state_np):
+        from libgrape_lite_tpu.parallel.comm_spec import put_global
+
+        mesh, _ = self._mesh_layout()
+        specs, _ = self._key_specs_batch(state_np)
+        return {
+            k: put_global(v, NamedSharding(mesh, specs[k]))
+            for k, v in state_np.items()
+        }
+
+    def _lane_stepper_parts(self, eph_vals):
+        """(strip, lane_peval, lane_inc): one lane's superstep closures
+        over the shared per-shard fragment + ephemeral streams — the
+        exact bodies of _make_runner, reused under vmap."""
+        app = self.app
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        ctx = StepContext()
+
+        def strip(s):
+            return {k: v for k, v in s.items() if k not in eph}
+
+        def lane_peval(frag, s):
+            s2, a = app.peval(ctx, frag, {**s, **eph_vals})
+            return strip(s2), jnp.int32(a)
+
+        def lane_inc(frag, s):
+            s2, a = app.inceval(ctx, frag, {**s, **eph_vals})
+            return strip(s2), jnp.int32(a)
+
+        return strip, lane_peval, lane_inc
+
+    @staticmethod
+    def _lane_body(lane_inc, frag, batch: int):
+        """One batched IncEval round with the per-lane freeze mask:
+        lanes whose vote has reached zero (or negative: cooperative
+        abort) keep their carry PINNED, so each lane executes exactly
+        the inceval sequence of its own sequential query and the
+        per-lane result is byte-identical to k separate Worker.query
+        runs — convergence raggedness costs masked (discarded) compute
+        on finished lanes, never a value change."""
+        def body(carry):
+            s, act, rv, r = carry
+            s2, a2 = jax.vmap(lambda st: lane_inc(frag, st))(s)
+            live = act > 0
+
+            def sel(new, old):
+                mask = live.reshape((batch,) + (1,) * (new.ndim - 1))
+                return jnp.where(mask, new, old)
+
+            s3 = jtu.tree_map(sel, s2, s)
+            a3 = jnp.where(live, a2, act)
+            r2 = r + jnp.int32(1)
+            return s3, a3, jnp.where(live, r2, rv), r2
+
+        return body
+
+    def _make_batched_runner(self, max_rounds: int, batch: int):
+        """Fused multi-source runner: the SAME PEval+IncEval loop as
+        _make_runner, vmapped over a leading lane axis of the carry.
+        Each lane is an independent query against the shared HBM-
+        resident fragment and ephemeral streams (pack tables, mirror
+        send tables, pre-masked weights ride once, not per lane); the
+        while_loop runs until EVERY lane's active vote has settled, and
+        the freeze mask (see _lane_body) keeps finished lanes pinned so
+        raggedness never perturbs results."""
+        app = self.app
+        mesh, frag_spec = self._mesh_layout()
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+
+        def stepper(frag_stacked, state, eph_state, squeezed):
+            frag = frag_stacked.local()
+            eph_vals = {k: v[0] for k, v in eph_state.items()}
+            st = _squeeze_lane_state(state, squeezed)
+            _, lane_peval, lane_inc = self._lane_stepper_parts(eph_vals)
+            st, active = jax.vmap(lambda s: lane_peval(frag, s))(st)
+            limit = jnp.int32(max_rounds if max_rounds > 0 else _INT32_MAX)
+
+            def cond(carry):
+                _, act, _, r = carry
+                return jnp.logical_and(jnp.any(act > 0), r < limit)
+
+            body = self._lane_body(lane_inc, frag, batch)
+            st, active, rounds_v, _ = lax.while_loop(
+                cond, body,
+                (st, active, jnp.zeros((batch,), jnp.int32),
+                 jnp.int32(0)),
+            )
+            return _unsqueeze_lane_state(st, squeezed), rounds_v, active
+
+        def compile_for(state):
+            specs, squeezed = self._key_specs_batch(state)
+            carry_specs = {k: v for k, v in specs.items() if k not in eph}
+            eph_specs = {k: v for k, v in specs.items() if k in eph}
+            sm = compat.shard_map(
+                partial(stepper, squeezed=squeezed),
+                mesh=mesh,
+                in_specs=(frag_spec, carry_specs, eph_specs),
+                out_specs=(carry_specs, P(), P()),
+                check_vma=False,
+            )
+            return jax.jit(sm, donate_argnums=(1,))
+
+        return compile_for
+
+    def _make_batched_chunk_runner(self, chunk: int, max_rounds: int,
+                                   batch: int):
+        """Batched analogue of _make_chunk_runner for the guarded serve
+        path: runs up to `chunk` global supersteps from an arbitrary
+        (per-lane active, per-lane rounds, global round) entry point,
+        emitting a per-lane carry digest + masked residual as extra
+        outputs of the same dispatch.  No carry donation — the per-lane
+        guard probes read the pre-chunk carry."""
+        app = self.app
+        mesh, frag_spec = self._mesh_layout()
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+
+        def stepper(frag_stacked, state, eph_state, active0, rv0, r0,
+                    squeezed):
+            frag = frag_stacked.local()
+            eph_vals = {k: v[0] for k, v in eph_state.items()}
+            st = _squeeze_lane_state(state, squeezed)
+            _, _, lane_inc = self._lane_stepper_parts(eph_vals)
+            limit = jnp.int32(max_rounds if max_rounds > 0 else _INT32_MAX)
+            stop = jnp.minimum(jnp.int32(r0) + jnp.int32(chunk), limit)
+
+            def cond(carry):
+                _, act, _, r = carry
+                return jnp.logical_and(jnp.any(act > 0), r < stop)
+
+            body = self._lane_body(lane_inc, frag, batch)
+            st, active, rv, r = lax.while_loop(
+                cond, body,
+                (st, jnp.asarray(active0, jnp.int32),
+                 jnp.asarray(rv0, jnp.int32), jnp.int32(r0)),
+            )
+            return _unsqueeze_lane_state(st, squeezed), rv, active, r
+
+        def compile_for(state):
+            specs, squeezed = self._key_specs_batch(state)
+            carry_specs = {k: v for k, v in specs.items() if k not in eph}
+            eph_specs = {k: v for k, v in specs.items() if k in eph}
+            sm = compat.shard_map(
+                partial(stepper, squeezed=squeezed),
+                mesh=mesh,
+                in_specs=(frag_spec, carry_specs, eph_specs, P(), P(), P()),
+                out_specs=(carry_specs, P(), P(), P()),
+                check_vma=False,
+            )
+
+            from libgrape_lite_tpu.guard.watchdog import carry_digest
+
+            float_keys = sorted(
+                k for k, v in state.items()
+                if k not in eph and np.dtype(v.dtype).kind == "f"
+            )
+
+            def lane_residual(out_f, st_f):
+                diffs = [
+                    jnp.max(jnp.where(
+                        jnp.isfinite(d), d, jnp.float32(0)
+                    ))
+                    for k in float_keys
+                    for d in [jnp.abs(
+                        out_f[k].astype(jnp.float32)
+                        - st_f[k].astype(jnp.float32)
+                    )]
+                ]
+                return jnp.max(jnp.stack(diffs))
+
+            def with_digest(frag_stacked, st, eph_state, active0, rv0, r0):
+                out, rv, active, r = sm(
+                    frag_stacked, st, eph_state, active0, rv0, r0
+                )
+                dig = jax.vmap(carry_digest)(out)  # [B, 2]
+                if float_keys:
+                    res = jax.vmap(lane_residual)(
+                        {k: out[k] for k in float_keys},
+                        {k: st[k] for k in float_keys},
+                    )
+                else:
+                    res = jnp.full((batch,), jnp.float32(-1))
+                return out, rv, active, r, dig, res
+
+            return jax.jit(with_digest)
+
+        return compile_for
+
+    def _batched_runner_for(self, max_rounds: int, batch: int, state):
+        key = (
+            "batched", batch, max_rounds,
+            self.app.trace_key(),
+            self._state_struct(state),
+        )
+        return self._cached_runner(
+            key,
+            lambda: self._make_batched_runner(max_rounds, batch)(state),
+        )
+
+    def _batched_chunk_runner_for(self, chunk: int, max_rounds: int,
+                                  batch: int, state):
+        key = (
+            "batched-chunk", chunk, batch, max_rounds,
+            self.app.trace_key(),
+            self._state_struct(state),
+        )
+        return self._cached_runner(
+            key,
+            lambda: self._make_batched_chunk_runner(
+                chunk, max_rounds, batch
+            )(state),
+        )
+
+    def query_batch(self, args_list, max_rounds: int | None = None, *,
+                    guard=None):
+        """Run k point queries as ONE vmapped dispatch over the shared
+        fragment (serve/, ROADMAP item 1): `args_list` carries one
+        query-arg dict per lane (e.g. [{"source": 3}, {"source": 9}]).
+        Per-lane results are byte-identical to k sequential
+        `Worker.query` runs (freeze-masked lanes, pinned by
+        tests/test_serve.py); per-lane round counts land in
+        `batch_rounds`, per-lane terminate codes in `batch_terminate`,
+        and lane b's carry is `batch_lane_state(b)`.
+
+        Guarded batched execution (per-lane monitors, breach isolation)
+        is driven by serve/batch.py — `guard` here routes there."""
+        self._check_batchable()
+        app = self.app
+        frag = self.fragment
+        mr = app.max_rounds if max_rounds is None else max_rounds
+        self._guard_monitor = None
+
+        from libgrape_lite_tpu.guard.config import GuardConfig
+
+        guard_cfg = GuardConfig.resolve(guard)
+        if guard_cfg.enabled:
+            from libgrape_lite_tpu.serve.batch import run_guarded_batch
+
+            return run_guarded_batch(self, args_list, mr, guard_cfg)
+
+        batch = len(args_list)
+        state = self._place_state_batch(
+            app.init_state_batch(frag, args_list)
+        )
+        runner = self._batched_runner_for(mr, batch, state)
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        carry = {k: v for k, v in state.items() if k not in eph}
+        eph_part = {k: v for k, v in state.items() if k in eph}
+        tr = obs.tracer()
+        try:
+            with tr.span("query", mode="batched",
+                         app=type(app).__name__, batch=batch) as sp:
+                out_state, rounds_v, active_v = runner(
+                    frag.dev, carry, eph_part
+                )
+                sp.mark("dispatched")
+                out_state = jax.block_until_ready(out_state)
+                rv = np.asarray(rounds_v)
+                av = np.asarray(active_v)
+                self.batch_rounds = rv
+                self.batch_terminate = np.minimum(0, av)
+                self.batch_breaches = [None] * batch
+                self.rounds = int(rv.max()) if batch else 0
+                self._terminate_code = (
+                    int(self.batch_terminate.min()) if batch else 0
+                )
+                if tr.enabled:
+                    # each lane pays PEval + its own counted IncEvals,
+                    # all inside the single batched dispatch (frozen-
+                    # lane recomputes are discarded, not counted)
+                    obs.metrics().counter(
+                        "grape_supersteps_total"
+                    ).inc(int(rv.sum()) + batch)
+                    sp.set(lane_rounds=[int(x) for x in rv])
+                self._finish_query_obs(sp)
+        finally:
+            if tr.enabled:
+                obs.flush()
+        self._result_state = {**out_state, **eph_part}
+        return self._result_state
+
+    def batch_lane_state(self, lane: int):
+        """Lane `lane`'s carry view of the last query_batch result
+        (ephemeral leaves are shared, not sliced)."""
+        if self._result_state is None or self.batch_rounds is None:
+            raise RuntimeError("query_batch() first")
+        eph = frozenset(getattr(self.app, "ephemeral_keys", ()) or ())
+        return {
+            k: (v if k in eph else v[lane])
+            for k, v in self._result_state.items()
+        }
+
+    def batch_result_values(self, lane: int) -> np.ndarray:
+        """Per-vertex assembled values for one lane, [fnum, vp] numpy."""
+        host = jax.device_get(self.batch_lane_state(lane))
+        return self.app.finalize(self.fragment, host)
 
     def query(self, max_rounds: int | None = None, *,
               checkpoint_every: int | None = None,
@@ -317,37 +697,64 @@ class Worker:
         the guard decision is a host-side env read, so the fused fast
         path is byte-identical and zero-overhead.  Guards on: the loop
         runs in fused chunks of GRAPE_GUARD_EVERY supersteps with an
-        invariant probe + watchdog digest at every boundary."""
+        invariant probe + watchdog digest at every boundary.
+
+        Guards + checkpointing compose WITHOUT the stepwise degrade
+        when `checkpoint_every` is a multiple of the guard chunk size:
+        chunk boundaries are consistent cuts, so snapshots come
+        straight from the chunk outputs (probed first — a state that
+        fails its invariants never becomes a rollback target) and the
+        inner loop stays the fused while_loop.  Misaligned cadences,
+        and checkpointing without guards, keep the stepwise path."""
+        from libgrape_lite_tpu.guard.config import GuardConfig
+
+        app = self.app
         if checkpoint_every is not None or checkpoint_dir is not None:
+            guard_cfg = GuardConfig.resolve(guard)
+            if (
+                guard_cfg.enabled
+                and checkpoint_every and checkpoint_dir
+                and checkpoint_every % guard_cfg.every == 0
+                and not getattr(app, "host_only", False)
+                and not hasattr(app, "collect_mutations")
+                and jax.process_count() == 1
+            ):
+                mr = app.max_rounds if max_rounds is None else max_rounds
+                return self._query_guarded(
+                    mr, guard_cfg,
+                    checkpoint_every=checkpoint_every,
+                    checkpoint_dir=checkpoint_dir,
+                    fault_plan=fault_plan, **query_args,
+                )
             return self.query_stepwise(
                 max_rounds, checkpoint_every=checkpoint_every,
                 checkpoint_dir=checkpoint_dir, fault_plan=fault_plan,
                 guard=guard, **query_args,
             )
-        app = self.app
         frag = self.fragment
         mr = app.max_rounds if max_rounds is None else max_rounds
         self._guard_monitor = None
 
-        from libgrape_lite_tpu.guard.config import GuardConfig
-
         guard_cfg = GuardConfig.resolve(guard)
         if guard_cfg.enabled:
             if getattr(app, "host_only", False):
-                from libgrape_lite_tpu.utils import logging as glog
+                if not getattr(app, "host_guard", False):
+                    from libgrape_lite_tpu.utils import logging as glog
 
-                glog.log_info(
-                    "guard: host-only apps have no superstep carry to "
-                    "monitor; guards are inert for "
-                    f"{type(app).__name__}"
-                )
+                    glog.log_info(
+                        "guard: host-only apps have no superstep carry "
+                        "to monitor; guards are inert for "
+                        f"{type(app).__name__}"
+                    )
             elif hasattr(app, "collect_mutations"):
                 # stepwise handles (and logs) the mutation restriction
                 return self.query_stepwise(
                     max_rounds, guard=guard, **query_args
                 )
             else:
-                return self._query_guarded(mr, guard_cfg, **query_args)
+                return self._query_guarded(
+                    mr, guard_cfg, fault_plan=fault_plan, **query_args
+                )
 
         tr = obs.tracer()
         if getattr(app, "host_only", False):
@@ -359,6 +766,15 @@ class Worker:
             kwargs = dict(query_args)
             if "max_rounds" in inspect.signature(app.host_compute).parameters:
                 kwargs["max_rounds"] = mr
+            if getattr(app, "host_guard", False):
+                # guard-capable host loops (exchange apps) run their
+                # own round-boundary probes; hand them THIS query's
+                # RESOLVED config — enabled or not — so
+                # Worker.query(guard=...) arms them like any superstep
+                # app AND an explicit guard="off" genuinely disarms an
+                # env-armed GRAPE_GUARD (the hooks fall back to the
+                # env only when no worker handed them a config)
+                app._host_guard_cfg = guard_cfg
             try:
                 with tr.span("query", mode="host",
                              app=type(app).__name__) as sp:
@@ -366,9 +782,11 @@ class Worker:
                     self.rounds = getattr(app, "rounds", 0)
                     self._finish_query_obs(sp)
             finally:
-                # flush in finally: a raising query must still land
-                # its spans/instants in the file sinks, not wait for
-                # the atexit hook
+                # a breach raise must still surface the monitor (for
+                # guard_report) and land its spans in the file sinks
+                self._guard_monitor = getattr(
+                    app, "_host_guard_monitor", None
+                )
                 if tr.enabled:
                     obs.flush()
             return self._result_state
@@ -461,15 +879,27 @@ class Worker:
                 tid=tr.frag_tid(f), round=rounds, frag=f,
             )
 
-    def _query_guarded(self, mr: int, guard_cfg, **query_args):
+    def _query_guarded(self, mr: int, guard_cfg, *,
+                       checkpoint_every: int | None = None,
+                       checkpoint_dir: str | None = None,
+                       fault_plan=None, **query_args):
         """Guarded-fused query: PEval once, then fused IncEval chunks
         of `guard_cfg.every` supersteps with an invariant probe +
         watchdog digest at every chunk boundary — a breach is detected
         within one cadence while the inner loop stays the fused
         `shard_map(while_loop)`.  Policies: warn logs and continues,
-        halt raises with the diagnostic bundle; rollback degrades to
-        halt here (snapshots require the checkpointed stepwise path —
-        the monitor logs the downgrade)."""
+        halt raises with the diagnostic bundle.
+
+        With `checkpoint_every` (a multiple of the chunk size — query()
+        enforces the alignment) snapshots are taken straight from the
+        chunk outputs at matching boundaries, AFTER the probe (a state
+        that fails its invariants never becomes the rollback target),
+        and the rollback policy self-heals in place: restore the last
+        good snapshot, rewind (rounds, active), and replay in paranoid
+        mode (chunk size 1, so a recurring deterministic fault is
+        localized to its exact superstep) — no stepwise degrade.
+        Fault-injection hooks (GRAPE_FT_FAULTS / `fault_plan`) fire at
+        chunk boundaries, the guarded path's consistent cuts."""
         from libgrape_lite_tpu.guard.monitor import GuardMonitor
         from libgrape_lite_tpu.utils import logging as glog
 
@@ -477,6 +907,14 @@ class Worker:
         frag = self.fragment
         if mr <= 0:  # 0 = run until the termination vote fires
             mr = _INT32_MAX
+
+        if fault_plan is None:
+            from libgrape_lite_tpu.ft.faults import active_plan
+
+            fault_plan = active_plan()
+        if fault_plan.is_noop():
+            fault_plan = None
+
         state = self._place_state(app.init_state(frag, **query_args))
         eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
         eph_part = {k: v for k, v in state.items() if k in eph}
@@ -484,27 +922,31 @@ class Worker:
         def carry_of(st):
             return {k: v for k, v in st.items() if k not in eph}
 
+        ckpt = None
+        if checkpoint_every:
+            from libgrape_lite_tpu.ft.checkpoint import CheckpointManager
+            from libgrape_lite_tpu.ft.fingerprint import (
+                canonical_query_args, compute_fingerprint,
+            )
+
+            ckpt = CheckpointManager(
+                checkpoint_dir,
+                fingerprint=compute_fingerprint(app, frag, query_args),
+                query_args=canonical_query_args(query_args),
+                checkpoint_every=checkpoint_every,
+                fresh_start=True,
+            )
+
         monitor = GuardMonitor(
-            app=app, frag=frag, config=guard_cfg,
+            app=app, frag=frag, config=guard_cfg, ckpt=ckpt,
             ledger=self.pack_ledger(),
         )
         self._guard_monitor = monitor
         glog.vlog(
-            1, "guard: fused chunks of %d supersteps (policy=%s)",
+            1, "guard: fused chunks of %d supersteps (policy=%s%s)",
             guard_cfg.every, guard_cfg.policy,
+            f", snapshots every {checkpoint_every}" if ckpt else "",
         )
-
-        def observe(prev, cur, rounds, active, digest=None,
-                    residual=None):
-            if active < 0:  # cooperative abort is the app's own verdict
-                return
-            breach = monitor.check(prev, cur, rounds, active,
-                                   digest=digest, residual=residual)
-            if breach is not None:
-                # rollback needs a checkpointed stepwise run; the
-                # monitor already downgraded + logged, so anything
-                # surviving a warn policy halts here
-                monitor.raise_breach(breach)
 
         tr = obs.tracer()
         try:
@@ -522,25 +964,49 @@ class Worker:
                         "grape_supersteps_total"
                     ).inc()
                 rounds = 0
-                observe(prev, carry, rounds, int(active))
+                if fault_plan is not None:
+                    corrupted = fault_plan.maybe_corrupt_carry(carry, 0)
+                    if corrupted is not None:
+                        carry = {**carry, **self._place_state(corrupted)}
+                if int(active) >= 0:
+                    # a PEval breach has no snapshot to restore — any
+                    # non-warn verdict halts
+                    breach = monitor.check(prev, carry, 0, int(active))
+                    if breach is not None:
+                        monitor.raise_breach(breach)
+                if ckpt is not None:
+                    # a superstep-0 snapshot always exists, so a breach
+                    # at any later chunk has something to fall back to
+                    ckpt.save_async(carry, 0, int(active))
+                if fault_plan is not None:
+                    fault_plan.on_superstep(0, ckpt)
                 chunk_fn = self._chunk_runner_for(
                     guard_cfg.every, mr, state
                 )
+                chunk1_fn = None  # paranoid replay compiles lazily
+                prev = carry
                 while int(active) > 0 and rounds < mr:
-                    prev = carry
+                    cf = chunk_fn
+                    if monitor.paranoid:
+                        if chunk1_fn is None:
+                            chunk1_fn = self._chunk_runner_for(
+                                1, mr, state
+                            )
+                        cf = chunk1_fn
                     r0 = rounds
                     with tr.span("chunk", start_round=r0) as sp:
-                        out = chunk_fn(frag.dev, carry, eph_part,
-                                       jnp.int32(int(active)),
-                                       jnp.int32(rounds))
+                        out = cf(frag.dev, carry, eph_part,
+                                 jnp.int32(int(active)),
+                                 jnp.int32(rounds))
                         sp.mark("dispatched")
-                        carry, r2, active, dig, res = (
+                        new_carry, r2, new_active, dig, res = (
                             jax.block_until_ready(out)
                         )
-                        rounds = int(r2)
-                        sp.set(end_round=rounds, active=int(active))
+                        sp.set(end_round=int(r2), active=int(new_active))
+                    rounds = int(r2)
                     if tr.enabled:
-                        tr.counter("active_vertices", value=int(active))
+                        tr.counter("active_vertices",
+                                   value=int(new_active))
                         m = obs.metrics()
                         # every superstep inside the chunk counts; the
                         # active series only has chunk-BOUNDARY samples
@@ -550,25 +1016,60 @@ class Worker:
                             rounds - r0
                         )
                         m.series("grape_active_per_round").append(
-                            int(active)
+                            int(new_active)
                         )
-                    # digest + residual rode out of the chunk dispatch
-                    # itself; the monitor skips its own probe when the
-                    # app declares no invariants, making guarded-fused
-                    # probing free of extra host syncs
+                    carry, active = new_carry, new_active
+                    # injected corruption lands BEFORE the probe (same-
+                    # round detection) and before the save; a corrupted
+                    # carry invalidates the in-dispatch digest/residual,
+                    # so the monitor re-probes fully
+                    digest = tuple(int(x) for x in np.asarray(dig))
                     res_f = float(res)
-                    observe(prev, carry, rounds, int(active),
-                            digest=tuple(
-                                int(x) for x in np.asarray(dig)
-                            ),
-                            residual=None if res_f < 0 else res_f)
+                    residual = None if res_f < 0 else res_f
+                    if fault_plan is not None:
+                        corrupted = fault_plan.maybe_corrupt_carry(
+                            carry, rounds
+                        )
+                        if corrupted is not None:
+                            carry = {
+                                **carry, **self._place_state(corrupted)
+                            }
+                            digest = residual = None
+                    if int(active) >= 0:
+                        breach = monitor.check(
+                            prev, carry, rounds, int(active),
+                            digest=digest, residual=residual,
+                        )
+                        if breach is not None:
+                            if breach.action == "rollback":
+                                restored, meta = monitor.rollback(breach)
+                                carry = self._place_state(restored)
+                                rounds = int(meta["rounds"])
+                                active = np.int32(meta["active"])
+                                prev = carry
+                                # the rollback rewinds past this
+                                # boundary's save and injection hooks
+                                continue
+                            monitor.raise_breach(breach)
+                    prev = carry
+                    if (
+                        ckpt is not None
+                        and rounds % checkpoint_every == 0
+                        and rounds > 0
+                    ):
+                        ckpt.save_async(carry, rounds, int(active))
+                    if fault_plan is not None:
+                        fault_plan.on_superstep(rounds, ckpt)
                 self.rounds = rounds
                 self._terminate_code = min(0, int(active))
                 self._finish_query_obs(qsp)
         finally:
             # flush in finally: a halt-policy breach raises out of the
             # span context, and its guard_breach instant must still
-            # land in the file sinks, not wait for the atexit hook
+            # land in the file sinks, not wait for the atexit hook;
+            # the in-flight snapshot must land durable the same way
+            if ckpt is not None:
+                ckpt.close()
             if tr.enabled:
                 obs.flush()
         self._result_state = {**carry, **eph_part}
@@ -616,6 +1117,50 @@ class Worker:
                 fn, mesh=mesh, in_specs=(frag_spec, specs),
                 out_specs=(out_specs, P()), check_vma=False,
             )
+        )
+
+    def _compile_batched_step(self, kind: str, state, batch: int):
+        """One jitted vmapped (PEval | IncEval) superstep over the lane
+        axis — the guarded serve path's building block (serve/batch.py
+        drives PEval once, then batched chunks)."""
+        mesh, frag_spec = self._mesh_layout()
+        specs, squeezed = self._key_specs_batch(state)
+        eph = frozenset(getattr(self.app, "ephemeral_keys", ()) or ())
+        out_specs = {k: v for k, v in specs.items() if k not in eph}
+
+        def fn(frag_stacked, st):
+            lf = frag_stacked.local()
+            eph_state = {k: st[k] for k in eph}
+            eph_vals = {k: v[0] for k, v in eph_state.items()}
+            s = _squeeze_lane_state(
+                {k: v for k, v in st.items() if k not in eph}, squeezed
+            )
+            _, lane_peval, lane_inc = self._lane_stepper_parts(eph_vals)
+            lane = lane_peval if kind == "peval" else lane_inc
+            s2, active = jax.vmap(lambda x: lane(lf, x))(s)
+            return _unsqueeze_lane_state(s2, squeezed), active
+
+        return jax.jit(
+            compat.shard_map(
+                fn, mesh=mesh, in_specs=(frag_spec, specs),
+                out_specs=(out_specs, P()), check_vma=False,
+            )
+        )
+
+    def _batched_step_for(self, kind: str, state, batch: int):
+        """Cached _compile_batched_step: a serve session dispatches
+        many guarded batches of the same shape, and each fresh jit
+        wrapper would retrace + recompile the identical vmapped PEval
+        (invisible to runner_cache_stats — the zero-recompile
+        accounting must see it)."""
+        key = (
+            "batched-step", kind, batch,
+            self.app.trace_key(),
+            self._state_struct(state),
+        )
+        return self._cached_runner(
+            key,
+            lambda: self._compile_batched_step(kind, state, batch),
         )
 
     def query_stepwise(self, max_rounds: int | None = None, *,

@@ -103,9 +103,42 @@ python scripts/trace_report.py "$OUT/trace.json" >/dev/null
 test -s "$OUT/trace.jsonl" && test -s "$OUT/metrics.prom"
 echo "  OK (trace + jsonl + metrics written, report rendered)"
 
-echo "== BENCH record schema (fresh small-scale bench + archived r05) =="
+echo "== serve: scripted 32-query stream through the CLI (fnum=2) =="
+# mixed stream: 24 sssp + 8 bfs queries coalesce per-app under
+# max_batch=8 — exercises admission, coalescing, and the vmapped
+# batched dispatch through the real user-facing surface
+python - > "$OUT/serve_stream.txt" <<'EOF'
+for i in range(24):
+    print("sssp", 6 + i)
+for i in range(8):
+    print("bfs", 6 + i)
+EOF
+python -m libgrape_lite_tpu.cli serve \
+  --efile "$DS/p2p-31.e" --vfile "$DS/p2p-31.v" $PLATFORM_ARGS --fnum 2 \
+  --stream "$OUT/serve_stream.txt" --max_batch 8 > "$OUT/serve.json"
+python - "$OUT/serve.json" <<'EOF'
+import json, sys
+rec = json.loads(
+    [l for l in open(sys.argv[1]) if l.startswith("{")][-1])
+assert rec["queries"] == 32 and rec["failed"] == 0, rec
+assert rec["apps"] == {"sssp": 24, "bfs": 8}, rec["apps"]
+assert sum(rec["batch_hist"].values()) >= 4, rec["batch_hist"]
+print(f"  OK (32 queries, {rec['qps']} q/s, hist {rec['batch_hist']})")
+EOF
+
+echo "== BENCH record schema (fresh small-scale bench incl. serve block + archived r05) =="
 GRAPE_BENCH_SCALE=10 GRAPE_BENCH_NO_PROBE=1 GRAPE_BENCH_NO_LEDGER=1 \
   GRAPE_BENCH_NO_GUARD=1 python bench.py > "$OUT/bench.json" 2>/dev/null
 python scripts/check_bench_schema.py "$OUT/bench.json" BENCH_r05.json
+python - "$OUT/bench.json" <<'EOF'
+import json, sys
+rec = json.loads(
+    [l for l in open(sys.argv[1]) if l.startswith("{")][-1])
+sv = rec["serve"]
+for app in ("sssp", "bfs"):
+    qps = {k: v["qps"] for k, v in sv[app].items()}
+    assert all(v["ok"] == v["n"] for v in sv[app].values()), sv[app]
+    print(f"  serve {app}: qps {qps}")
+EOF
 
 echo "ALL APP TESTS PASSED"

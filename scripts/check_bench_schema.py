@@ -40,6 +40,7 @@ _TOP = {
     "guard": (dict, False),
     "pack_ledger": (dict, False),
     "obs": (dict, False),
+    "serve": (dict, False),
 }
 
 _SSSP = {
@@ -79,6 +80,25 @@ _OBS = {
     "spans": (dict, True),
 }
 
+# the r9 serving-throughput lane: per app, per batch size (keys b<k>),
+# qps at fixed p99 over the scripted stream; batch_hist is the
+# admission queue's batch-size histogram (digit-string keys)
+_SERVE = {
+    "scale": (int, True),
+    "queries_per_app": (int, True),
+    "sssp": (dict, False),
+    "bfs": (dict, False),
+    "batch_hist": (dict, True),
+}
+
+_SERVE_POINT = {
+    "qps": (_NUM, True),
+    "p50_ms": (_NUM, True),
+    "p99_ms": (_NUM, True),
+    "n": (int, True),
+    "ok": (int, True),
+}
+
 _SPAN_ROLLUP = {
     "count": (int, True),
     "total_s": (_NUM, True),
@@ -92,6 +112,7 @@ SCHEMA = {
     "guard": _GUARD,
     "pack_ledger": _PACK_LEDGER,
     "obs": _OBS,
+    "serve": _SERVE,
 }
 
 
@@ -133,7 +154,8 @@ def validate_record(record) -> list:
         return [f"record is {type(record).__name__}, expected object"]
     _check_block(record, _TOP, "record", errors)
     for key, spec in (("sssp", _SSSP), ("guard", _GUARD),
-                      ("pack_ledger", _PACK_LEDGER), ("obs", _OBS)):
+                      ("pack_ledger", _PACK_LEDGER), ("obs", _OBS),
+                      ("serve", _SERVE)):
         block = record.get(key)
         if isinstance(block, dict):
             _check_block(block, spec, key, errors)
@@ -159,6 +181,36 @@ def validate_record(record) -> list:
                 errors.append(f"obs.spans[{name!r}]: expected object")
                 continue
             _check_block(r, _SPAN_ROLLUP, f"obs.spans[{name!r}]", errors)
+    sv = record.get("serve")
+    if isinstance(sv, dict):
+        for app in ("sssp", "bfs"):
+            blk = sv.get(app)
+            if not isinstance(blk, dict):
+                continue
+            for bkey, point in blk.items():
+                where = f"serve.{app}[{bkey!r}]"
+                if not (bkey.startswith("b") and bkey[1:].isdigit()):
+                    errors.append(
+                        f"{where}: batch keys must look like b<k>"
+                    )
+                    continue
+                if not isinstance(point, dict):
+                    errors.append(f"{where}: expected object")
+                    continue
+                _check_block(point, _SERVE_POINT, where, errors)
+        bh = sv.get("batch_hist")
+        if isinstance(bh, dict):
+            for k, v in bh.items():
+                if not (isinstance(k, str) and k.isdigit()):
+                    errors.append(
+                        f"serve.batch_hist[{k!r}]: keys are decimal "
+                        "batch sizes"
+                    )
+                if not isinstance(v, int) or isinstance(v, bool):
+                    errors.append(
+                        f"serve.batch_hist[{k!r}]: expected int count, "
+                        f"got {type(v).__name__}"
+                    )
     return errors
 
 
@@ -214,7 +266,7 @@ def main(argv=None) -> int:
                     print(f"  - {e}")
             else:
                 blocks = [k for k in ("sssp", "guard", "pack_ledger",
-                                      "obs") if k in record]
+                                      "obs", "serve") if k in record]
                 print(f"OK {label} ({record.get('metric')}"
                       + (f"; blocks: {', '.join(blocks)}" if blocks
                          else "") + ")")

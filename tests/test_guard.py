@@ -616,3 +616,163 @@ def test_bc_invariants_catch_negative_and_nan(graph_cache):
     assert breach2 is not None
     assert "finite(delta)" in breach2.verdict["failed"]
     assert "in_range(delta)" in breach2.verdict["failed"]
+
+
+# ---- exchange-app invariant floor (ISSUE 6 satellite) --------------------
+
+
+@pytest.mark.parametrize("app_name", ["sssp_msg", "sssp_delta"])
+def test_exchange_apps_declare_distance_invariants(graph_cache, app_name):
+    """sssp_msg/sssp_delta graduate from the generic NaN floor to the
+    dist>=0 + monotone algebra models/sssp.py declares; a clean guarded
+    run probes every round and changes nothing."""
+    from libgrape_lite_tpu.models import APP_REGISTRY
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    w0 = Worker(APP_REGISTRY[app_name](), frag)
+    w0.query(source=6)
+    want = w0.result_values()
+
+    w = Worker(APP_REGISTRY[app_name](), frag)
+    w.query(source=6, guard="halt")
+    assert w.result_values().tobytes() == want.tobytes()
+    rep = w.guard_report
+    assert rep is not None and rep["probes"] > 0
+    assert any(i.startswith("in_range(dist)") for i in rep["invariants"])
+    assert any(
+        i.startswith("monotone_non_increasing(dist)")
+        for i in rep["invariants"]
+    )
+
+
+@pytest.mark.parametrize("app_name", ["sssp_msg", "sssp_delta"])
+def test_exchange_apps_corrupt_carry_drill(graph_cache, app_name,
+                                           monkeypatch):
+    """corrupt_carry@2 through the host-loop hooks: injected NaN is
+    detected the SAME round by the exchange app's own probe."""
+    from libgrape_lite_tpu.guard import InvariantBreachError
+    from libgrape_lite_tpu.models import APP_REGISTRY
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    monkeypatch.setenv("GRAPE_FT_FAULTS", "corrupt_carry@2")
+    w = Worker(APP_REGISTRY[app_name](), frag)
+    with pytest.raises(InvariantBreachError) as ei:
+        w.query(source=6, guard="halt")
+    assert ei.value.bundle["round"] == 2
+    failed = ei.value.bundle["verdict"]["failed"]
+    assert any(name.startswith("in_range(dist)") for name in failed)
+
+
+# ---- guarded-fused snapshots, no stepwise degrade (ISSUE 6 satellite) ----
+
+
+def test_guarded_fused_checkpoints_from_chunk_outputs(graph_cache,
+                                                      tmp_path):
+    """checkpoint_every a multiple of the guard chunk size keeps the
+    fused chunked path (no query_stepwise degrade): snapshots land at
+    chunk boundaries, results stay byte-identical, and the checkpoints
+    resume like stepwise ones."""
+    from libgrape_lite_tpu import obs
+    from libgrape_lite_tpu.ft.checkpoint import list_checkpoints
+    from libgrape_lite_tpu.guard import GuardConfig
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    ref = Worker(SSSP(), frag)
+    ref.query(source=6)
+    want = ref.result_values()
+
+    ckdir = str(tmp_path / "ck")
+    obs.configure(in_memory=True)
+    try:
+        w = Worker(SSSP(), frag)
+        w.query(
+            checkpoint_every=4, checkpoint_dir=ckdir,
+            guard=GuardConfig(policy="halt", every=2), source=6,
+        )
+        names = [e.get("name") for e in obs.history()]
+        # the fused chunked path ran — not the stepwise degrade
+        assert "chunk" in names
+        assert "superstep" not in names
+        qspans = [
+            e for e in obs.history()
+            if e.get("name") == "query"
+            and (e.get("args") or {}).get("mode")
+        ]
+        assert qspans[-1]["args"]["mode"] == "guarded-fused"
+    finally:
+        obs.reset()
+    assert w.result_values().tobytes() == want.tobytes()
+    assert w.guard_report["probes"] > 0
+
+    steps = [s for s, _ in list_checkpoints(ckdir)]
+    assert steps, "no snapshots written"
+    assert all(s % 4 == 0 for s in steps), steps
+
+    # the chunk-output snapshots restore through the normal resume path
+    w2 = Worker(SSSP(), frag)
+    w2.resume(ckdir)
+    assert w2.result_values().tobytes() == want.tobytes()
+
+
+def test_guarded_fused_misaligned_cadence_keeps_stepwise(graph_cache,
+                                                         tmp_path):
+    """checkpoint_every NOT a multiple of the chunk size keeps the
+    probe-before-save stepwise contract (test_probe_forced_on_
+    checkpoint_rounds pins its semantics)."""
+    from libgrape_lite_tpu import obs
+    from libgrape_lite_tpu.guard import GuardConfig
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    obs.configure(in_memory=True)
+    try:
+        w = Worker(SSSP(), frag)
+        w.query(
+            checkpoint_every=3, checkpoint_dir=str(tmp_path / "ck"),
+            guard=GuardConfig(policy="halt", every=2), source=6,
+        )
+        names = [e.get("name") for e in obs.history()]
+        assert "superstep" in names  # stepwise ran
+    finally:
+        obs.reset()
+
+
+def test_guarded_fused_rollback_self_heals(graph_cache, tmp_path):
+    """Self-heal THROUGH the fused chunked path: cadence-aligned
+    checkpoints + rollback policy + corrupt_carry -> detected at a
+    chunk boundary, rolled back to a chunk-output snapshot, replayed
+    paranoid (chunk size 1), byte-identical to fault-free."""
+    from libgrape_lite_tpu import obs
+    from libgrape_lite_tpu.ft.faults import FaultPlan
+    from libgrape_lite_tpu.guard import GuardConfig
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    ref = Worker(SSSP(), frag)
+    ref.query(source=6)
+    want = ref.result_values()
+
+    obs.configure(in_memory=True)
+    try:
+        w = Worker(SSSP(), frag)
+        w.query(
+            checkpoint_every=4, checkpoint_dir=str(tmp_path / "ck"),
+            guard=GuardConfig(policy="rollback", every=2),
+            fault_plan=FaultPlan(corrupt_carry_at=4), source=6,
+        )
+        names = [e.get("name") for e in obs.history()]
+        assert "chunk" in names and "superstep" not in names
+        assert "rollback" in names
+    finally:
+        obs.reset()
+    assert w.result_values().tobytes() == want.tobytes()
+    rep = w.guard_report
+    assert rep["rollbacks"] == 1
+    assert rep["paranoid"]
+    assert rep["breaches"][0]["round"] == 4  # boundary = same round

@@ -43,6 +43,49 @@ def test_runner_cache_respects_query_params():
     assert w.rounds == 7
 
 
+def test_runner_cache_keys_max_rounds():
+    """A second query with a different `max_rounds` on the SAME worker
+    must compile its own runner, not silently reuse the first one: the
+    round limit is baked into the while_loop cond (ISSUE 6 satellite;
+    the serve compatibility key pins the same contract in
+    tests/test_serve.py)."""
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    # a 32-vertex path: convergence takes 31 relaxation rounds, so a
+    # stale 2-round compile would be unmissable
+    n = 32
+    src = np.arange(n - 1)
+    dst = np.arange(1, n)
+    w_edge = np.ones(n - 1)
+    frag = build_fragment(src, dst, w_edge, n, 2)
+
+    w = Worker(SSSP(), frag)
+    w.query(max_rounds=2, source=0)
+    assert w.rounds == 2
+    capped = w.result_values()
+    stats_after_first = dict(w.runner_cache_stats)
+
+    w.query(max_rounds=0, source=0)  # 0 = run to convergence
+    # n-1 improving rounds + the final no-change round that votes stop
+    assert w.rounds == n
+    full = w.result_values()
+    assert np.isinf(capped).sum() > np.isinf(full).sum()
+    # the second limit was a genuine second compile, not a cache hit
+    assert (
+        w.runner_cache_stats["misses"]
+        == stats_after_first["misses"] + 1
+    )
+
+    # and repeating either limit hits its own cached runner
+    w.query(max_rounds=2, source=0)
+    assert w.rounds == 2
+    assert (
+        w.runner_cache_stats["misses"]
+        == stats_after_first["misses"] + 1
+    )
+
+
 def test_lcc_tiny_graph():
     """n_pad < 32 exercises the ceil in the bitmap word count
     (regression: words = n_pad // 32 zeroed the bitmaps)."""
