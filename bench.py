@@ -53,6 +53,17 @@ admission queue's batch-size histogram.  Env knobs:
 GRAPE_BENCH_NO_SERVE=1 skips, GRAPE_BENCH_SERVE_SCALE /
 GRAPE_BENCH_SERVE_QUERIES size the lane.
 
+BENCH-json dyn fields (r10): `dyn` carries the dynamic-graph lane
+(dyn/, docs/DYNAMIC_GRAPHS.md) — `updates_per_s` ingested through
+ServeSession.ingest while an SSSP query stream stays live (overlay
+side-path below the repack threshold: zero replanning/recompiles),
+`repack_count` / `overlay_applies`, live-query ok counts, and the
+incremental-IncEval point: `inc_seeded_rounds` vs `inc_cold_rounds`
+and the `inc_speedup` wall ratio of `Worker.query_incremental` seeded
+from the pre-delta fixed point against a cold recompute.  Env knobs:
+GRAPE_BENCH_NO_DYN=1 skips, GRAPE_BENCH_DYN_SCALE /
+GRAPE_BENCH_DYN_UPDATES size the lane.
+
 Baseline derivation (BASELINE.md): the reference GPU backend runs
 PageRank on soc-LiveJournal1 (68.99M directed edges) in 24.65 ms on
 8× V100 (`Performance.md:94`), i.e. 68.99e6 * 10 rounds / 0.02465 s
@@ -116,19 +127,14 @@ def _backend_alive(timeout_s: int = 150) -> bool:
         return False
 
 
-def build_bench_fragment(scale: int | None = None):
-    """The bench graph + fragment, shared with scripts/seed_pack_plans.py
-    so the pre-seeded plan-cache digests stay bit-identical by
-    construction.  The real load path: hash-partitioned vertex map over
-    the native open-addressing idxer (round 1 bypassed VertexMap with an
-    identity idxer because the dict path was load-bound; the native
-    table is ~30x faster, so the bench exercises the honest path).
-    `scale` overrides GRAPE_BENCH_SCALE (the serve lane runs a smaller
-    twin of the same construction)."""
-    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+def build_bench_inputs(scale: int | None = None):
+    """(n, src, dst, comm_spec, vm): the bench graph's host-side
+    inputs — shared by every lane so RMAT draws and the vertex map
+    stay bit-identical by construction.  Lanes that only build a
+    WEIGHTED twin (the dyn lane) stop here and skip the unweighted
+    shard build + device upload."""
     from libgrape_lite_tpu.parallel.comm_spec import CommSpec
     from libgrape_lite_tpu.utils.id_parser import IdParser
-    from libgrape_lite_tpu.utils.types import LoadStrategy
     from libgrape_lite_tpu.vertex_map.idxer import HashMapIdxer
     from libgrape_lite_tpu.vertex_map.partitioner import (
         SegmentedPartitioner,
@@ -141,6 +147,22 @@ def build_bench_fragment(scale: int | None = None):
     oids = np.arange(n, dtype=np.int64)
     part = SegmentedPartitioner(1, oids)
     vm = VertexMap(part, [HashMapIdxer(oids)], IdParser(1, n))
+    return n, src, dst, comm_spec, vm
+
+
+def build_bench_fragment(scale: int | None = None):
+    """The bench graph + fragment, shared with scripts/seed_pack_plans.py
+    so the pre-seeded plan-cache digests stay bit-identical by
+    construction.  The real load path: hash-partitioned vertex map over
+    the native open-addressing idxer (round 1 bypassed VertexMap with an
+    identity idxer because the dict path was load-bound; the native
+    table is ~30x faster, so the bench exercises the honest path).
+    `scale` overrides GRAPE_BENCH_SCALE (the serve lane runs a smaller
+    twin of the same construction)."""
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+
+    n, src, dst, comm_spec, vm = build_bench_inputs(scale)
     frag = ShardedEdgecutFragment.build(
         comm_spec, vm, src, dst, None,
         directed=False,
@@ -149,9 +171,12 @@ def build_bench_fragment(scale: int | None = None):
     return n, src, dst, comm_spec, vm, frag
 
 
-def build_bench_weighted_fragment(src, dst, comm_spec, vm):
+def build_bench_weighted_fragment(src, dst, comm_spec, vm,
+                                  retain_edge_list=False):
     """The SSSP lane's weighted twin (seed-11 uniform(0.1,10) f32) —
-    also shared with the plan-cache seeder."""
+    also shared with the plan-cache seeder.  The dyn lane builds its
+    twin with retain_edge_list=True (the repack path edits the host
+    edge list)."""
     from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
     from libgrape_lite_tpu.utils.types import LoadStrategy
 
@@ -161,6 +186,7 @@ def build_bench_weighted_fragment(src, dst, comm_spec, vm):
         comm_spec, vm, src, dst, w,
         directed=False,
         load_strategy=LoadStrategy.kBothOutIn,
+        retain_edge_list=retain_edge_list,
     )
 
 
@@ -511,6 +537,132 @@ def main():
             _emit_record(record)
         except Exception as e:  # the serve lane must not cost the bench
             print(f"[bench] serve lane failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+    # dynamic-graph lane (r10, ROADMAP item 4): updates/sec ingested
+    # while a query stream stays live, plus the incremental-vs-cold
+    # comparison (dyn/, docs/DYNAMIC_GRAPHS.md).  A dyn-enabled
+    # session pins a weighted RMAT twin; a reproducible additive
+    # update stream (scripts/gen_rmat.py delta_edges — the SAME
+    # distribution the --delta flag scripts) ingests in chunks between
+    # 4-query groups, riding the overlay below the repack threshold so
+    # the live queries recompile nothing.  The incremental point:
+    # Worker.query_incremental seeded from the pre-delta fixed point
+    # vs a cold recompute on the mutated view, wall and rounds.
+    # GRAPE_BENCH_NO_DYN=1 skips; GRAPE_BENCH_DYN_SCALE /
+    # GRAPE_BENCH_DYN_UPDATES size the lane.
+    if not os.environ.get("GRAPE_BENCH_NO_DYN"):
+        try:
+            from libgrape_lite_tpu.dyn import DynGraph, RepackPolicy
+            from libgrape_lite_tpu.models import SSSP
+            from libgrape_lite_tpu.serve import (
+                BatchPolicy,
+                ServeSession,
+            )
+
+            sys.path.insert(
+                0, os.path.join(os.path.dirname(os.path.abspath(
+                    __file__)), "scripts"))
+            from gen_rmat import delta_edges
+
+            dyn_scale = int(os.environ.get(
+                "GRAPE_BENCH_DYN_SCALE", min(SCALE, 12)))
+            n_upd = int(os.environ.get(
+                "GRAPE_BENCH_DYN_UPDATES", 1024))
+            dn, dsrc, ddst, dcomm, dvm = build_bench_inputs(dyn_scale)
+            dfrag = build_bench_weighted_fragment(
+                dsrc, ddst, dcomm, dvm, retain_edge_list=True
+            )
+            u_src, u_dst = delta_edges(dyn_scale, n_upd, seed=29)
+            rng_uw = np.random.default_rng(31)
+            u_w = rng_uw.uniform(0.1, 10.0, n_upd)
+            ops = [("a", int(s), int(d), float(x)) for s, d, x in
+                   zip(u_src, u_dst, u_w)]
+            # capacity sized to hold the full stream as an overlay;
+            # the ratio threshold still fires if the stream is large
+            # relative to the graph (a counted repack, reported below)
+            sess = ServeSession(
+                dfrag, policy=BatchPolicy(max_batch=8),
+                dyn=RepackPolicy(capacity=max(4096, 2 * n_upd)),
+            )
+            rng_q = np.random.default_rng(17)
+            warm_sources = [int(x) for x in rng_q.integers(0, dn, 8)]
+            for s in warm_sources:
+                sess.submit("sssp", {"source": s})
+            sess.drain()  # warm the batched runner shapes
+
+            chunk = max(1, n_upd // 8)
+            q_ok = q_n = 0
+            t0 = time.perf_counter()
+            oi = 0
+            while oi < len(ops):
+                for s in rng_q.integers(0, dn, 4):
+                    sess.submit("sssp", {"source": int(s)})
+                res = sess.drain()
+                q_n += len(res)
+                q_ok += sum(1 for r in res if r.ok)
+                sess.ingest(ops[oi:oi + chunk])
+                oi += chunk
+            wall = time.perf_counter() - t0
+            dyn_block = {
+                "updates_per_s": round(n_upd / wall, 1),
+                "ingested": sess.stats["ingested_ops"],
+                "repack_count": sess.stats["repacks"],
+                "overlay_applies": sess.stats["overlay_applies"],
+                "queries": q_n,
+                "queries_ok": q_ok,
+            }
+            print(
+                f"[bench] dyn: {dyn_block['updates_per_s']} upd/s "
+                f"({n_upd} ingested, {q_n} queries live, "
+                f"{dyn_block['repack_count']} repack(s))",
+                file=sys.stderr,
+            )
+
+            # incremental-vs-cold: seed from the pre-delta fixed point
+            from libgrape_lite_tpu.worker.worker import Worker
+
+            base = build_bench_weighted_fragment(
+                dsrc, ddst, dcomm, dvm, retain_edge_list=True
+            )
+            w_prev = Worker(SSSP(), base)
+            prev = w_prev.query(source=0)
+            dg = DynGraph(base, RepackPolicy(
+                capacity=max(4096, 2 * n_upd)))
+            small = ops[:max(1, n_upd // 16)]
+            # the report's delta snapshot stays valid even if the
+            # apply repacked (summary() would then be empty)
+            inc_delta = dg.ingest(small)["delta"]
+            w_cold = Worker(SSSP(), dg.fragment)
+            w_cold.query(source=0)  # warm (compiles the overlay shape)
+            tc = time.perf_counter()
+            w_cold.query(source=0)
+            t_cold = time.perf_counter() - tc
+            # prev came from a DIFFERENT worker on the pre-ingest
+            # fragment: name it, so a repacking ingest still migrates
+            # the seeded rows by oid instead of trusting the layout
+            w_inc = Worker(SSSP(), dg.fragment)
+            w_inc.query_incremental(prev, inc_delta,
+                                    prev_fragment=base, source=0)
+            ti = time.perf_counter()
+            w_inc.query_incremental(prev, inc_delta,
+                                    prev_fragment=base, source=0)
+            t_inc = time.perf_counter() - ti
+            dyn_block["inc_cold_rounds"] = int(w_cold.rounds)
+            dyn_block["inc_seeded_rounds"] = int(w_inc.rounds)
+            dyn_block["inc_speedup"] = round(
+                t_cold / t_inc, 3) if t_inc > 0 else 0.0
+            print(
+                f"[bench] dyn incremental: seeded {w_inc.rounds} "
+                f"rounds / {t_inc:.4f}s vs cold {w_cold.rounds} "
+                f"rounds / {t_cold:.4f}s "
+                f"({dyn_block['inc_speedup']}x)",
+                file=sys.stderr,
+            )
+            record["dyn"] = dyn_block
+            _emit_record(record)
+        except Exception as e:  # the dyn lane must not cost the bench
+            print(f"[bench] dyn lane failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
 
     # static op-budget ledger (r6): the planner's exact per-stage ALU

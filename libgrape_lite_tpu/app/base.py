@@ -78,6 +78,24 @@ class StepContext(Communicator):
         return jnp.concatenate([x_local, recv.reshape(-1)])
 
 
+def source_lane_array(frag, source, app_name: str, fill, hit, dtype):
+    """(batched, arr): the serve source-vector contract's shared
+    scaffolding.  `source` is one query id or a SEQUENCE of k lane ids
+    (`batch_query_key`); `arr` is [k, fnum, vp] holding `hit` at each
+    resolved source and `fill` everywhere else — SSSP seeds distances
+    (inf/0), BFS depths (sentinel/0), personalized PageRank its
+    teleport vector (0/1).  An absent or None source leaves its lane
+    all-`fill` (the unreachable/zero-mass convention)."""
+    batched = isinstance(source, (list, tuple, np.ndarray))
+    sources = list(source) if batched else [source]
+    arr = np.full((len(sources), frag.fnum, frag.vp), fill, dtype=dtype)
+    for b, s in enumerate(sources):
+        pid = resolve_source(frag, s, app_name) if s is not None else -1
+        if pid >= 0:
+            arr[b, pid // frag.vp, pid % frag.vp] = hit
+    return batched, arr
+
+
 def resolve_source(frag, source, app_name: str) -> int:
     """oid -> pid for a query source, logging when absent (shared by
     SSSP/BFS/BC; the reference's GetInnerVertex miss is silent, a
@@ -135,6 +153,33 @@ class AppBase:
     # shared across lanes.  None = no native vector support; the
     # generic `init_state_batch` stacking fallback applies.
     batch_query_key: str | None = None
+
+    # dyn/: True when the app folds a fragment's staged delta-edge
+    # overlay (frag.dyn_overlay) into its pull reduction — sound only
+    # for min-fold apps, where extra candidates merge exactly.  Apps
+    # without the contract must not run while an overlay holds staged
+    # edges (they would silently see the stale graph); Worker.query
+    # enforces this, and ServeSession repacks first.
+    dyn_overlay_support: bool = False
+
+    # dyn/: the incremental-IncEval contract (dyn/incremental.py).
+    #   None            — no contract; query_incremental recomputes cold
+    #   "monotone-min"  — additive deltas reuse the previous fixed
+    #                     point: seeded = min(fresh_init, migrated prev)
+    #                     per key in `inc_seed_keys`, byte-identical to
+    #                     a cold run on the mutated graph
+    #   "restart"       — declared, but the iteration has no reusable
+    #                     fixed point (fixed-round PageRank): cold, counted
+    inc_mode: str | None = None
+    inc_seed_keys: Dict[str, str] = {}
+
+    def inc_value_map(self, key: str, values: np.ndarray, old_frag,
+                      new_frag) -> np.ndarray:
+        """Remap carry VALUES across a repack (row migration is the
+        framework's job; value remapping is the app's).  Default:
+        identity — right for distances/depths; WCC overrides to
+        re-address its pid-valued component labels."""
+        return values
 
     def custom_specs(self) -> Dict:
         """Per-key PartitionSpec overrides for state leaves that are
@@ -221,14 +266,9 @@ class AppBase:
 
     def migrate_state(self, old_frag, new_frag, old_state, new_state):
         """Copy per-vertex state rows across a rebuild, matching by oid."""
-        old_oids = np.concatenate(
-            [old_frag.inner_oids(f) for f in range(old_frag.fnum)]
-        )
-        old_pids = old_frag.oid_to_pid(old_oids)
-        new_pids = new_frag.oid_to_pid(old_oids)
-        keep = new_pids >= 0
-        of, ol = old_pids[keep] // old_frag.vp, old_pids[keep] % old_frag.vp
-        nf, nl = new_pids[keep] // new_frag.vp, new_pids[keep] % new_frag.vp
+        from libgrape_lite_tpu.fragment.mutation import oid_row_alignment
+
+        of, ol, nf, nl = oid_row_alignment(old_frag, new_frag)
         out = dict(new_state)
         for k, v in new_state.items():
             if k in self.replicated_keys:
@@ -268,6 +308,22 @@ class AppBase:
         from libgrape_lite_tpu.ops.segment import segment_reduce
 
         return segment_reduce(values, edge_src, vp, kind)
+
+    @staticmethod
+    def dyn_min_fold(relaxed, state: Dict, vp: int, prefix: str, cand):
+        """Merge the staged delta-edge overlay (dyn/ingest.py) into a
+        pull-mode min reduction.  `cand` is the [capacity] per-slot
+        candidate vector, already masked to the fold's neutral element
+        on inactive slots; rows come from the overlay's lid-sorted
+        `src` plane (pad slots route to the vp overflow row).  `min`
+        is associative, so the merged result is byte-identical to a
+        cold query on the rebuilt mutated graph — the whole point of
+        the side-path: the packed CSR, its plans, and the compiled
+        runner never change."""
+        extra = AppBase.segment_reduce(
+            cand, state[prefix + "src"], vp, "min"
+        )
+        return jnp.minimum(relaxed, extra)
 
 
 class ParallelAppBase(AppBase):

@@ -116,6 +116,18 @@ def make_serve_parser() -> argparse.ArgumentParser:
                    choices=["", "off", "warn", "halt", "rollback"],
                    help="per-lane guard policy (breach isolation: a "
                         "poisoned lane fails alone)")
+    p.add_argument("--delta_stream", default="",
+                   help="dyn/ live ingest: a delta-op stream file "
+                        "('a src dst [w]' / 'd src dst' / 'u src dst "
+                        "w' lines, scripts/gen_rmat.py --delta emits "
+                        "one); chunks are ingested between query "
+                        "batches while the stream runs")
+    p.add_argument("--ingest_every", type=int, default=8,
+                   help="queries pumped between delta-chunk ingests")
+    p.add_argument("--dyn_repack_ratio", type=float, default=None,
+                   help="delta ratio past which staged ops fold into "
+                        "a rebuilt CSR (default GRAPE_DYN_REPACK_RATIO "
+                        "or 0.05); below it, ingest is zero-recompile")
     p.add_argument("--fnum", type=int, default=None)
     p.add_argument("--string_id", action="store_true")
     p.add_argument("--trace", default="",
@@ -198,25 +210,68 @@ def serve_main(argv=None):
     weighted = any(
         getattr(APP_REGISTRY[a], "needs_edata", False) for a, _ in queries
     )
+
+    # dyn/ live ingest: parse the delta stream up front (reproducible
+    # chunking, malformed lines fail BEFORE the load) with the SAME
+    # weightedness as the graph — a weighted serve must not silently
+    # ingest zero-cost edges from an unweighted stream
+    delta_ops = []
+    if ns.delta_stream:
+        from libgrape_lite_tpu.dyn import parse_ops_file
+
+        delta_ops = parse_ops_file(
+            ns.delta_stream, weighted=weighted, string_id=ns.string_id
+        )
     spec = LoadGraphSpec(
         directed=ns.directed, weighted=weighted,
         string_id=ns.string_id, edata_dtype=np.float64,
+        retain_edge_list=bool(ns.delta_stream),
     )
     with timer.phase("load graph"):
         frag = LoadGraph(ns.efile, ns.vfile or None,
                          CommSpec(fnum=ns.fnum), spec)
 
+    dyn = None
+    if ns.delta_stream:
+        from libgrape_lite_tpu.dyn import RepackPolicy
+
+        dyn = (
+            RepackPolicy(threshold=ns.dyn_repack_ratio)
+            if ns.dyn_repack_ratio is not None
+            else RepackPolicy.from_env()
+        )
     sess = ServeSession(
         frag,
         policy=BatchPolicy(max_batch=ns.max_batch,
                            max_wait_s=ns.max_wait_ms / 1e3),
         guard=ns.guard or None,
+        dyn=dyn,
     )
     t0 = time.perf_counter()
     for app_key, src in queries:
         sess.submit(app_key, {"source": src},
                     max_rounds=ns.max_rounds or None)
-    results = sess.drain()
+    if delta_ops:
+        # streaming mode: ingest a delta chunk after every
+        # --ingest_every pumped queries, so updates land between
+        # batches while the query stream stays live (the host-pumped
+        # loop makes each ingest a consistent superstep boundary)
+        ingest_every = max(1, ns.ingest_every)
+        n_chunks = max(1, -(-len(queries) // ingest_every))
+        chunk = -(-len(delta_ops) // n_chunks)
+        oi = 0
+        results = []
+        while sess.queue.pending() or oi < len(delta_ops):
+            pumped = 0
+            while sess.queue.pending() and pumped < ingest_every:
+                got = sess.pump(force=True)
+                results.extend(got)
+                pumped += len(got)
+            if oi < len(delta_ops):
+                sess.ingest(delta_ops[oi:oi + chunk])
+                oi += chunk
+    else:
+        results = sess.drain()
     wall = time.perf_counter() - t0
 
     lat = sorted(r.latency_s for r in results)
@@ -240,6 +295,21 @@ def serve_main(argv=None):
         "apps": per_app,
         "cache": sess.cache_stats(),
     }
+    if delta_ops:
+        # the same field names as bench.py's schema-checked dyn block
+        # (scripts/check_bench_schema.py _DYN), so both surfaces
+        # validate against one declaration
+        record["dyn"] = {
+            "ingested": sess.stats["ingested_ops"],
+            "overlay_applies": sess.stats["overlay_applies"],
+            "repack_count": sess.stats["repacks"],
+            "queries": len(results),
+            "queries_ok": ok,
+            "updates_per_s": (
+                round(sess.stats["ingested_ops"] / wall, 2)
+                if wall > 0 else 0.0
+            ),
+        }
     print(json.dumps(record), flush=True)
     if results and not ok:
         print("[serve] every query failed", file=sys.stderr)

@@ -1,0 +1,41 @@
+"""dyn/ — dynamic-graph runtime (ROADMAP item 4, docs/DYNAMIC_GRAPHS.md).
+
+Delta-edge buffers staged against the frozen packed CSR, applied at
+superstep boundaries as either a dense overlay side-path (zero
+replanning, zero recompiles) or an amortized repack; incremental
+IncEval seeds queries from the previous fixed point; ServeSession
+ingests update streams between batches while queries stay live.
+"""
+
+from libgrape_lite_tpu.dyn.delta import (
+    DeltaBuffer,
+    DeltaOverflowError,
+    DeltaSummary,
+    parse_ops_file,
+    parse_ops_line,
+)
+from libgrape_lite_tpu.dyn.incremental import (
+    incremental_plan,
+    reseed_fold,
+)
+from libgrape_lite_tpu.dyn.ingest import (
+    DeltaOverlay,
+    DynGraph,
+    overlay_state_entries,
+)
+from libgrape_lite_tpu.dyn.repack import RepackPolicy, repack_fragment
+
+__all__ = [
+    "DeltaBuffer",
+    "DeltaOverflowError",
+    "DeltaSummary",
+    "DeltaOverlay",
+    "DynGraph",
+    "RepackPolicy",
+    "incremental_plan",
+    "overlay_state_entries",
+    "parse_ops_file",
+    "parse_ops_line",
+    "repack_fragment",
+    "reseed_fold",
+]

@@ -46,6 +46,10 @@ class LoadGraphSpec:
     serialization_prefix: str = ""
     vid_dtype: type = np.int32
     edata_dtype: type = np.float32
+    # keep the original oid edge list on the fragment — required for
+    # rebuild-on-mutate and the dyn/ repack path (deserialize-path
+    # loads cannot retain it: the cache stores only the built shards)
+    retain_edge_list: bool = False
 
 
 def _cache_dir(efile: str, vfile: str, spec: LoadGraphSpec, fnum: int) -> str:
@@ -177,6 +181,7 @@ def LoadGraph(
                 load_strategy=spec.load_strategy,
                 vid_dtype=spec.vid_dtype,
                 edata_dtype=spec.edata_dtype,
+                retain_edge_list=spec.retain_edge_list,
             )
             frag.load_spec = spec  # preserved across rebuild-on-mutate
 

@@ -50,6 +50,31 @@ def _pair_match(src: np.ndarray, dst: np.ndarray, pairs) -> np.ndarray:
         )
 
 
+def oid_row_alignment(old_frag, new_frag):
+    """(of, ol, nf, nl): row coordinates aligning old_frag's
+    [fnum, vp] per-vertex layout to new_frag's, matched by oid, for
+    every vertex present in BOTH maps — the one migration rule shared
+    by `AppBase.migrate_state` (mid-query MutationContext rebuilds)
+    and `dyn.incremental.migrate_rows` (incremental-IncEval seeding)."""
+    old_oids = (
+        np.concatenate(
+            [old_frag.inner_oids(f) for f in range(old_frag.fnum)]
+        )
+        if old_frag.fnum
+        else np.zeros(0, np.int64)
+    )
+    if len(old_oids) == 0:
+        z = np.zeros(0, np.int64)
+        return z, z, z, z
+    old_pids = old_frag.oid_to_pid(old_oids)
+    new_pids = new_frag.oid_to_pid(old_oids)
+    keep = (old_pids >= 0) & (new_pids >= 0)
+    return (
+        old_pids[keep] // old_frag.vp, old_pids[keep] % old_frag.vp,
+        new_pids[keep] // new_frag.vp, new_pids[keep] % new_frag.vp,
+    )
+
+
 @dataclass
 class BasicFragmentMutator:
     """Staged mutation set (reference basic_fragment_mutator.h API)."""
@@ -152,7 +177,7 @@ class BasicFragmentMutator:
 
 
 def _build_edgecut(comm_spec, oids, src, dst, w, directed, spec):
-    from libgrape_lite_tpu.fragment.loader import LoadGraphSpec
+    from libgrape_lite_tpu.fragment.loader import LoadGraphSpec, _validate_load
     from libgrape_lite_tpu.utils.types import LoadStrategy
 
     spec = spec or LoadGraphSpec(directed=directed)
@@ -167,7 +192,11 @@ def _build_edgecut(comm_spec, oids, src, dst, w, directed, spec):
         retain_edge_list=True,
     )
     frag.load_spec = spec
-    return frag
+    # the same GRAPE_VALIDATE_LOAD=1 gate every load/deserialize path
+    # honors: a rebuild-on-mutate (delta apply, dyn/ repack) must not
+    # be the one CSR construction that skips structural validation —
+    # a tampered delta corrupts shards exactly like a tampered cache
+    return _validate_load(frag)
 
 
 def parse_delta_efile(path: str, weighted: bool, mutator: BasicFragmentMutator,

@@ -80,6 +80,7 @@ class GuardMonitor:
         self.paranoid = False
         self.rollbacks = 0
         self.probes = 0
+        self.mutations = 0  # mutation boundaries crossed (dyn/)
         self.breaches: List[dict] = []
         self._invariants = None
         self._probe = None
@@ -99,6 +100,39 @@ class GuardMonitor:
 
     def can_rollback(self) -> bool:
         return self.ckpt is not None
+
+    def on_mutation(self, new_frag, ledger=None) -> None:
+        """Mutation-boundary reset (dyn/): after a delta apply the
+        graph — and with it the deterministic superstep operator —
+        changed.  A digest match against a pre-mutation round no
+        longer proves a cycle (the same carry under a DIFFERENT
+        operator evolves differently), so the watchdog history must
+        clear or a legitimately re-visited state raises a
+        false-positive DivergenceError.  The compiled probe is also
+        dropped: state shapes and the fragment arrays it binds may
+        have been rebuilt.  `ledger` is the re-resolved pack ledger
+        for post-mutation breach bundles — the pre-mutation snapshot
+        would misattribute modeled cost, so absent a fresh one it is
+        nulled rather than left stale."""
+        self.frag = new_frag
+        self._ledger = ledger
+        self.mutations += 1
+        self.watchdog.reset()
+        self._probe = None
+        self._probe_inv = None
+        self._invariants = None
+        # a pre-mutation snapshot is NOT a valid rollback target for
+        # the rebuilt graph (shapes/pids may differ, and replaying
+        # would re-run already-applied mutations) — drop it so a later
+        # rollback verdict degrades to halt.  Unreachable today
+        # (checkpointing MutationContext apps is rejected up front),
+        # but cheap insurance against that restriction loosening.
+        self.ckpt = None
+        obs.tracer().instant("guard_mutation_reset")
+        glog.vlog(
+            1, "guard: mutation boundary — watchdog history reset, "
+            "probe re-resolves against the mutated fragment",
+        )
 
     def _resolve(self, carry: Dict) -> None:
         declared = self.app.invariants(self.frag, carry)
@@ -386,6 +420,7 @@ class GuardMonitor:
             "probes": self.probes,
             "paranoid": self.paranoid,
             "rollbacks": self.rollbacks,
+            "mutations": self.mutations,
             "breaches": list(self.breaches),
             "invariants": [i.name for i in (self._invariants or [])],
         }

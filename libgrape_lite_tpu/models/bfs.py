@@ -26,29 +26,40 @@ class BFS(ParallelAppBase):
     message_strategy = MessageStrategy.kSyncOnOuterVertex
     result_format = "int"
     batch_query_key = "source"  # serve/: [k]-source batched dispatch
+    # dyn/: unit-weight tropical relax — additive deltas fold exactly,
+    # and the previous depth vector seeds incremental IncEval
+    dyn_overlay_support = True
+    inc_mode = "monotone-min"
+    inc_seed_keys = {"depth": "min"}
 
     def init_state(self, frag, source=0):
         import os
 
-        from libgrape_lite_tpu.app.base import resolve_source
+        from libgrape_lite_tpu.app.base import source_lane_array
 
         # a SEQUENCE of sources builds the batched [k, fnum, vp] carry
         # for the serve/ vmapped multi-source dispatch (ephemeral
         # streams below are built once and shared across lanes)
-        batched = isinstance(source, (list, tuple, np.ndarray))
-        sources = list(source) if batched else [source]
-        depth = np.full((len(sources), frag.fnum, frag.vp), _SENTINEL,
-                        dtype=np.int32)
-        for b, s in enumerate(sources):
-            pid = resolve_source(frag, s, "BFS")
-            if pid >= 0:
-                depth[b, pid // frag.vp, pid % frag.vp] = 0
+        batched, depth = source_lane_array(
+            frag, source, "BFS", _SENTINEL, 0, np.int32
+        )
         depth = depth if batched else depth[0]
         state = {"depth": depth}
         eph_entries = {}
         from libgrape_lite_tpu.parallel.mirror import resolve_mirror_plan
 
-        self._mx = resolve_mirror_plan(frag, "ie")
+        # dyn/ overlay (see SSSP.init_state): pid-addressed side
+        # arrays, mirror compaction off while attached
+        self._dyn = getattr(frag, "dyn_overlay", None) is not None
+        if self._dyn:
+            from libgrape_lite_tpu.dyn.ingest import overlay_state_entries
+
+            eph_entries.update(
+                overlay_state_entries(frag, "ie", None, "dyn_ie_")
+            )
+            self._mx = None
+        else:
+            self._mx = resolve_mirror_plan(frag, "ie")
         if self._mx is not None:
             eph_entries.update(self._mx.state_entries("mx_"))
         self._mx_uid = self._mx.uid if self._mx is not None else -1
@@ -110,6 +121,18 @@ class BFS(ParallelAppBase):
             )
             relaxed = self.segment_reduce(cand, ie.edge_src, frag.vp,
                                           "min")
+        if "dyn_ie_nbr" in state:
+            # staged delta edges (dyn/): extra unit-weight candidates
+            # merged at the fold; `full` is pid-addressed in overlay
+            # mode (init_state disables mirror compaction)
+            dv = full[state["dyn_ie_nbr"]]
+            dcand = jnp.where(
+                jnp.logical_and(state["dyn_ie_mask"], dv != sent),
+                dv + 1, sent,
+            )
+            relaxed = self.dyn_min_fold(
+                relaxed, state, frag.vp, "dyn_ie_", dcand
+            )
         new = jnp.minimum(depth, relaxed)
         changed = jnp.logical_and(new < depth, frag.inner_mask)
         active = ctx.sum(changed.sum().astype(jnp.int32))

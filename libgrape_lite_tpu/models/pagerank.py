@@ -36,13 +36,48 @@ class PageRank(BatchShuffleAppBase):
     need_split_edges = True
     result_format = "float"
     replicated_keys = frozenset({"step", "dangling_sum", "total_dangling"})
+    # serve/: personalized PageRank batches over the per-lane seed via
+    # the same source-vector contract SSSP/BFS use (app/base.py);
+    # global queries (no source) fall back to generic lane stacking
+    batch_query_key = "source"
+    # dyn/: PageRank runs exactly max_round steps from a fixed init —
+    # there is no fixed point to reuse at finite rounds, so the
+    # incremental contract is an honest counted restart
+    inc_mode = "restart"
 
     def __init__(self, delta: float = 0.85, max_round: int = 10):
         self.delta = delta
         self.max_round = max_round
+        self._personalized = False
+
+    def init_state_batch(self, frag, args_list):
+        """Vector-seed batching only when EVERY lane carries a source
+        (personalized); all-global lanes take the generic stacking
+        fallback — the cheap path's default-fill would otherwise
+        silently personalize a global query at vertex 0.  A MIX of
+        the two cannot share one batch (personalized carries trace a
+        seed leaf, global ones don't): fail with the reason instead
+        of a bare KeyError out of the stacker.  The serve compat key
+        keeps mixed lanes apart upstream; this guards the direct
+        Worker.query_batch surface."""
+        seeded = ["source" in a and a["source"] is not None
+                  for a in args_list]
+        if not any(seeded):
+            key, self.batch_query_key = self.batch_query_key, None
+            try:
+                return super().init_state_batch(frag, args_list)
+            finally:
+                self.batch_query_key = key
+        if not all(seeded):
+            raise ValueError(
+                "personalized (source given) and global PageRank "
+                "lanes cannot share one batch — their carries have "
+                "different structure; batch them separately"
+            )
+        return super().init_state_batch(frag, args_list)
 
     def init_state(self, frag, delta: float | None = None,
-                   max_round: int | None = None):
+                   max_round: int | None = None, source=None):
         if delta is not None:
             self.delta = delta
         if max_round is not None:
@@ -60,6 +95,17 @@ class PageRank(BatchShuffleAppBase):
             else default_f
         )
         self.dtype = np.dtype(dtype) if np.dtype(dtype).kind == "f" else np.dtype(default_f)
+        # personalized PageRank (PPR): `source` turns the uniform
+        # teleport vector into a one-hot seed; a SEQUENCE of sources
+        # builds the [k, ...] batched carry for the serve/ vmapped
+        # dispatch (seed mass 1 per lane; an absent source leaves a
+        # zero seed — rank identically zero, like SSSP's unreachable
+        # convention).  source=None keeps the LDBC global variant
+        # BIT-IDENTICAL: no seed leaf enters the state and the legacy
+        # scalar base formula below is untouched.
+        batched = isinstance(source, (list, tuple, np.ndarray))
+        sources = list(source) if batched else [source]
+        self._personalized = any(s is not None for s in sources)
         rank = np.zeros((frag.fnum, frag.vp), dtype=self.dtype)
         state = {
             "rank": rank,
@@ -67,6 +113,24 @@ class PageRank(BatchShuffleAppBase):
             "dangling_sum": self.dtype.type(0),
             "total_dangling": self.dtype.type(0),
         }
+        if self._personalized:
+            from libgrape_lite_tpu.app.base import source_lane_array
+
+            _, seed = source_lane_array(
+                frag, sources, "PageRank", 0.0, 1.0, self.dtype
+            )
+            k = len(sources)
+            if batched:
+                state = {
+                    "rank": np.zeros((k, frag.fnum, frag.vp),
+                                     dtype=self.dtype),
+                    "step": np.zeros((k,), np.int32),
+                    "dangling_sum": np.zeros((k,), self.dtype),
+                    "total_dangling": np.zeros((k,), self.dtype),
+                    "seed": seed,
+                }
+            else:
+                state["seed"] = seed[0]
         # SpMV path selection (GRAPE_SPMV env: auto|xla|strict|pack):
         #   pack   — the pack-gather Pallas pipeline (ops/spmv_pack.py),
         #            f32 + single-shard; the round-2 perf design
@@ -121,7 +185,13 @@ class PageRank(BatchShuffleAppBase):
             self._spmv_tile = plan[1] if plan else 0
             self._spmv_rmax = plan[2] if plan else 0
             if plan:
-                state["spmv_row_lo"] = plan[0]
+                row_lo = plan[0]
+                if batched:
+                    # pass-through carry leaves need the lane axis too
+                    row_lo = np.broadcast_to(
+                        row_lo, (len(sources),) + row_lo.shape
+                    ).copy()
+                state["spmv_row_lo"] = row_lo
         else:
             self._spmv_tile = self._spmv_rmax = 0
         return state
@@ -129,9 +199,32 @@ class PageRank(BatchShuffleAppBase):
     def peval(self, ctx: StepContext, frag, state):
         n = frag.total_vnum
         dt = state["rank"].dtype
-        p = jnp.asarray(1.0 / n, dt)
         deg = frag.out_degree
         dangling = jnp.logical_and(frag.inner_mask, deg == 0)
+        if self._personalized:
+            # PPR: the teleport vector is the one-hot seed s instead of
+            # the uniform 1/n — same rank/deg stored form, and the two
+            # conserved scalars become seed MASSES (total_dangling =
+            # seed mass sitting on dangling vertices; dangling_sum =
+            # that same mass at init)
+            s = state["seed"]
+            rank = jnp.where(
+                frag.inner_mask,
+                jnp.where(deg > 0, s / jnp.maximum(deg, 1).astype(dt), s),
+                jnp.asarray(0, dt),
+            )
+            total_dangling = ctx.sum(
+                jnp.where(dangling, s, jnp.asarray(0, dt)).sum()
+            )
+            state = dict(
+                state,
+                rank=rank,
+                step=jnp.int32(0),
+                dangling_sum=total_dangling,
+                total_dangling=total_dangling,
+            )
+            return state, jnp.int32(1 if self.max_round > 0 else 0)
+        p = jnp.asarray(1.0 / n, dt)
         rank = jnp.where(
             frag.inner_mask,
             jnp.where(deg > 0, p / jnp.maximum(deg, 1).astype(dt), p),
@@ -156,8 +249,21 @@ class PageRank(BatchShuffleAppBase):
         d = self.delta
         dt = state["rank"].dtype
         step = state["step"] + 1
-        base = jnp.asarray((1.0 - d) / n, dt) + jnp.asarray(d / n, dt) * state["dangling_sum"]
-        dangling_sum = base * state["total_dangling"]
+        if self._personalized:
+            # PPR: teleport + dangling mass both land on the seed, so
+            # the scalar base becomes a per-vertex vector scal * s_v;
+            # the mass that re-lands on dangling vertices is scal *
+            # (seed mass on dangling) — same conservation algebra as
+            # the global variant with e_seed in place of 1/n
+            scal = (
+                jnp.asarray(1.0 - d, dt)
+                + jnp.asarray(d, dt) * state["dangling_sum"]
+            )
+            base = scal * state["seed"]
+            dangling_sum = scal * state["total_dangling"]
+        else:
+            base = jnp.asarray((1.0 - d) / n, dt) + jnp.asarray(d / n, dt) * state["dangling_sum"]
+            dangling_sum = base * state["total_dangling"]
         deg = frag.out_degree
         nxt = jnp.where(
             deg > 0,
@@ -224,6 +330,7 @@ class PageRank(BatchShuffleAppBase):
 
         mr = self.max_round
         rtol = self.mass_rtol
+        personalized = self._personalized
 
         def mass_fn(dev, prev, cur):
             rank = cur["rank"]
@@ -232,13 +339,23 @@ class PageRank(BatchShuffleAppBase):
             iter_mass = jnp.where(deg > 0, rank * deg, rank).sum()
             is_final = cur["step"] >= jnp.int32(mr)
             mass = jnp.where(is_final, rank.sum(), iter_mass)
-            err = jnp.abs(mass - jnp.asarray(1.0, dt))
+            # PPR conserves the SEED mass (1 when the source resolves,
+            # 0 for an absent seed) instead of the global unit mass
+            target = (
+                cur["seed"].sum() if personalized
+                else jnp.asarray(1.0, dt)
+            )
+            err = jnp.abs(mass - target)
             return err <= jnp.asarray(rtol, dt), err
 
         out = [finite("rank"), in_range("rank", lo=0.0)]
         if mr > 0:  # a 0-round query never leaves the rank/deg form
+            requires = (
+                ("rank", "step", "seed") if personalized
+                else ("rank", "step")
+            )
             out.append(Invariant(
-                "pagerank_mass", mass_fn, ("rank", "step"),
+                "pagerank_mass", mass_fn, requires,
                 f"total probability mass conserved within {rtol:g}",
             ))
         return out

@@ -29,6 +29,11 @@ class SSSP(ParallelAppBase):
     result_format = "sssp_infinity"
     needs_edata = True  # double edata (run_app.cc:48-52)
     batch_query_key = "source"  # serve/: [k]-source batched dispatch
+    # dyn/: staged additive deltas fold exactly into the tropical min
+    # relax, and the previous fixed point seeds incremental IncEval
+    dyn_overlay_support = True
+    inc_mode = "monotone-min"
+    inc_seed_keys = {"dist": "min"}
 
     def init_state(self, frag, source=0):
         import os
@@ -46,19 +51,14 @@ class SSSP(ParallelAppBase):
         if not jax.config.jax_enable_x64:
             # honest TPU dtype: x64-off would downcast silently anyway
             dtype = np.float32
-        from libgrape_lite_tpu.app.base import resolve_source
+        from libgrape_lite_tpu.app.base import source_lane_array
 
         # a SEQUENCE of sources builds the batched [k, fnum, vp] carry
         # for the serve/ vmapped multi-source dispatch — the ephemeral
         # streams below are built once and shared across lanes
-        batched = isinstance(source, (list, tuple, np.ndarray))
-        sources = list(source) if batched else [source]
-        dist = np.full((len(sources), frag.fnum, frag.vp), np.inf,
-                       dtype=dtype)
-        for b, s in enumerate(sources):
-            pid = resolve_source(frag, s, "SSSP")
-            if pid >= 0:
-                dist[b, pid // frag.vp, pid % frag.vp] = 0.0
+        batched, dist = source_lane_array(
+            frag, source, "SSSP", np.inf, 0.0, dtype
+        )
         dist = dist if batched else dist[0]
         # tropical pack pipeline (ops/spmv_pack.py, GRAPE_SPMV=pack):
         # min-relaxation with the f32 weight stream baked into the plan
@@ -76,7 +76,23 @@ class SSSP(ParallelAppBase):
             "0", "")
         from libgrape_lite_tpu.parallel.mirror import resolve_mirror_plan
 
-        self._mx = resolve_mirror_plan(frag, "ie")
+        # dyn/ overlay: staged delta edges ride as ephemeral side
+        # arrays and fold into the relax below.  Their neighbor reads
+        # index the pid-addressed full gather, so mirror compaction is
+        # disabled while an overlay is attached (the entries are
+        # present — possibly all-masked — whenever the fragment is
+        # dyn-managed, keeping the compiled state structure stable
+        # across ingests: zero recompiles below the repack threshold)
+        self._dyn = getattr(frag, "dyn_overlay", None) is not None
+        if self._dyn:
+            from libgrape_lite_tpu.dyn.ingest import overlay_state_entries
+
+            eph_entries.update(
+                overlay_state_entries(frag, "ie", dtype, "dyn_ie_")
+            )
+            self._mx = None
+        else:
+            self._mx = resolve_mirror_plan(frag, "ie")
         if self._mx is not None:
             eph_entries.update(self._mx.state_entries("mx_"))
         self._mx_uid = self._mx.uid if self._mx is not None else -1
@@ -147,6 +163,19 @@ class SSSP(ParallelAppBase):
                 ie.edge_mask, full[nbr] + ie.edge_w, inf
             )
             relaxed = self.segment_reduce(cand, ie.edge_src, frag.vp, "min")
+        if "dyn_ie_nbr" in state:
+            # staged delta edges (dyn/): one extra gather + segment_min
+            # over the dense overlay slots, merged at the fold — `full`
+            # is pid-addressed here (mirror compaction is off in
+            # overlay mode, see init_state)
+            inf = jnp.asarray(jnp.inf, dist.dtype)
+            dcand = jnp.where(
+                state["dyn_ie_mask"],
+                full[state["dyn_ie_nbr"]] + state["dyn_ie_w"], inf,
+            )
+            relaxed = self.dyn_min_fold(
+                relaxed, state, frag.vp, "dyn_ie_", dcand
+            )
         new = jnp.minimum(dist, relaxed)
         changed = jnp.logical_and(new < dist, frag.inner_mask)
         active = ctx.sum(changed.sum().astype(jnp.int32))

@@ -25,6 +25,12 @@ class WCC(ParallelAppBase):
     load_strategy = LoadStrategy.kBothOutIn
     message_strategy = MessageStrategy.kSyncOnOuterVertex
     result_format = "int"
+    # dyn/: min-gid propagation is a tropical fold — additive deltas
+    # merge exactly, and the previous labeling seeds incremental
+    # IncEval (labels remapped across repacks via inc_value_map)
+    dyn_overlay_support = True
+    inc_mode = "monotone-min"
+    inc_seed_keys = {"comp": "min"}
 
     def init_state(self, frag, **_):
         import os
@@ -40,7 +46,21 @@ class WCC(ParallelAppBase):
         from libgrape_lite_tpu.parallel.mirror import resolve_mirror_plan
 
         self._mx_ie = self._mx_oe = None
-        self._mx_ie = resolve_mirror_plan(frag, "ie")
+        # dyn/ overlay (see SSSP.init_state): pid-addressed side
+        # arrays for each pull direction, mirror compaction off
+        self._dyn = getattr(frag, "dyn_overlay", None) is not None
+        if self._dyn:
+            from libgrape_lite_tpu.dyn.ingest import overlay_state_entries
+
+            eph_entries.update(
+                overlay_state_entries(frag, "ie", None, "dyn_ie_")
+            )
+            if frag.directed:
+                eph_entries.update(
+                    overlay_state_entries(frag, "oe", None, "dyn_oe_")
+                )
+        else:
+            self._mx_ie = resolve_mirror_plan(frag, "ie")
         if self._mx_ie is not None:
             eph_entries.update(self._mx_ie.state_entries("mx_ie_"))
             if frag.directed:
@@ -90,7 +110,7 @@ class WCC(ParallelAppBase):
         return state, jnp.int32(1)
 
     def _pull(self, ctx, frag, comp, csr, pack=None, state=None,
-              mx=None, mx_prefix="mx_ie_"):
+              mx=None, mx_prefix="mx_ie_", dyn_prefix=None):
         big = jnp.int32(np.iinfo(np.int32).max)
         if mx is not None:
             full = ctx.exchange_mirrors(comp, state[mx_prefix + "send"])
@@ -102,11 +122,23 @@ class WCC(ParallelAppBase):
             # tropical min over the static pack routes: labels travel
             # as exact f32 ints; rows with no edges come back +inf
             red = pack.reduce(full.astype(jnp.float32), state, "min")
-            return jnp.where(
+            red = jnp.where(
                 jnp.isfinite(red), red.astype(jnp.int32), big
             )
-        cand = jnp.where(csr.edge_mask, full[nbr], big)
-        return self.segment_reduce(cand, csr.edge_src, frag.vp, "min")
+        else:
+            cand = jnp.where(csr.edge_mask, full[nbr], big)
+            red = self.segment_reduce(cand, csr.edge_src, frag.vp, "min")
+        if dyn_prefix is not None and dyn_prefix + "nbr" in state:
+            # staged delta edges (dyn/): extra label candidates merged
+            # at the fold; `full` is pid-addressed in overlay mode
+            # (init_state disables mirror compaction)
+            dcand = jnp.where(
+                state[dyn_prefix + "mask"],
+                full[state[dyn_prefix + "nbr"]], big,
+            )
+            red = self.dyn_min_fold(red, state, frag.vp, dyn_prefix,
+                                    dcand)
+        return red
 
     def _post_pull(self, ctx, frag, new):
         """Hook between the neighbor pull and the change count —
@@ -118,18 +150,43 @@ class WCC(ParallelAppBase):
         new = jnp.minimum(
             comp,
             self._pull(ctx, frag, comp, frag.ie, self._pack_ie, state,
-                       self._mx_ie, "mx_ie_"),
+                       self._mx_ie, "mx_ie_", dyn_prefix="dyn_ie_"),
         )
         if frag.directed:
             new = jnp.minimum(
                 new,
                 self._pull(ctx, frag, new, frag.oe, self._pack_oe, state,
-                           self._mx_oe, "mx_oe_"),
+                           self._mx_oe, "mx_oe_", dyn_prefix="dyn_oe_"),
             )
         new = self._post_pull(ctx, frag, new)
         changed = jnp.logical_and(new < comp, frag.inner_mask)
         active = ctx.sum(changed.sum().astype(jnp.int32))
         return {"comp": new}, active
+
+    def inc_value_map(self, key, values, old_frag, new_frag):
+        """Component labels are PIDS, so a repack (which renumbers the
+        pid space) must re-address the label VALUES, not just migrate
+        rows: old representative pid -> its oid -> its new pid.  A
+        representative absent from the new map (only possible for
+        non-additive deltas, which never reach the seeded path) falls
+        back to the sentinel — no information, the fresh init wins."""
+        if old_frag is new_frag or key != "comp":
+            return values
+        sent = np.iinfo(np.int32).max
+        flat = np.asarray(values).reshape(-1)
+        valid = flat != sent
+        if not valid.any():
+            return values
+        reps = np.unique(flat[valid])
+        rep_oids = old_frag.pid_to_oid(reps)
+        new_reps = new_frag.oid_to_pid(np.asarray(rep_oids))
+        new_reps = np.where(new_reps < 0, sent, new_reps).astype(
+            values.dtype
+        )
+        idx = np.searchsorted(reps, flat[valid])
+        out = flat.copy()
+        out[valid] = new_reps[idx]
+        return out.reshape(np.asarray(values).shape)
 
     def invariants(self, frag, state):
         # min-gid propagation: labels are pids (or the pad sentinel)

@@ -56,4 +56,10 @@ def compat_key(app_key: str, args: dict, max_rounds, guard,
         (k, v) for k, v in args.items() if k != batch_key
     ))
     policy = getattr(guard, "policy", guard) or ""
-    return (app_key, max_rounds, str(policy), fixed)
+    # whether the lane ARG is present is itself structural: a
+    # personalized-PageRank lane (source given) and a global lane
+    # (no source) trace different states and must not share a batch
+    has_lane_arg = (
+        batch_key is not None and args.get(batch_key) is not None
+    )
+    return (app_key, max_rounds, str(policy), fixed, has_lane_arg)

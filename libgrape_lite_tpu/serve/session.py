@@ -48,14 +48,34 @@ from libgrape_lite_tpu.worker.worker import Worker
 class ServeSession:
     def __init__(self, fragment, apps: Dict | None = None,
                  policy: BatchPolicy | None = None,
-                 guard: Optional[str] = None):
+                 guard: Optional[str] = None, dyn=None):
         """`apps` maps app_key -> app factory (default: the full
         APP_REGISTRY); `guard` is the session-default guard policy
-        (per-request `guard=` wins)."""
+        (per-request `guard=` wins).
+
+        `dyn` enables live ingest (dyn/, docs/DYNAMIC_GRAPHS.md):
+        True (env-configured RepackPolicy), a RepackPolicy, or a
+        pre-built DynGraph.  The session then accepts `ingest(ops)`
+        between pumps — staged deltas ride the overlay side-path
+        (zero replanning, zero recompiles) until the repack policy
+        folds them, a counted recompile event.  Requires the fragment
+        loaded with retain_edge_list=True for the repack path."""
         if apps is None:
             from libgrape_lite_tpu.models import APP_REGISTRY
 
             apps = dict(APP_REGISTRY)
+        self.dyn = None
+        if dyn is not None and dyn is not False:
+            from libgrape_lite_tpu.dyn import DynGraph, RepackPolicy
+
+            if isinstance(dyn, DynGraph):
+                self.dyn = dyn
+            else:
+                self.dyn = DynGraph(
+                    fragment,
+                    policy=None if dyn is True else dyn,
+                )
+            fragment = self.dyn.fragment
         self.fragment = fragment
         self.apps = apps
         self.policy = policy or BatchPolicy()
@@ -67,6 +87,8 @@ class ServeSession:
         self.stats = {
             "queries": 0, "batches": 0, "failed": 0,
             "sequential_fallbacks": 0,
+            "ingested_ops": 0, "overlay_applies": 0, "repacks": 0,
+            "forced_repacks": 0,
         }
 
     # ---- resident workers -------------------------------------------------
@@ -98,6 +120,64 @@ class ServeSession:
             runner["hits"] += w.runner_cache_stats["hits"]
             runner["misses"] += w.runner_cache_stats["misses"]
         return {"runner": runner, "pack": plan_stats()}
+
+    # ---- live ingest (dyn/) ----------------------------------------------
+
+    def ingest(self, ops, *, force_repack: bool = False) -> dict:
+        """Apply a batch of delta ops between dispatches (the host-
+        pumped loop makes this a superstep boundary by construction —
+        no query is ever mid-flight here).  Below the repack threshold
+        the staged edges ride the overlay side-path and the next query
+        of a warmed shape compiles NOTHING (runner cache hit, zero
+        pack planning — pinned by tests/test_dyn.py); at a repack the
+        rebuilt fragment is adopted into every resident worker and the
+        recompiles that follow are COUNTED in cache_stats, never
+        silent.  Returns the DynGraph report ({mode, staged, ...})."""
+        if self.dyn is None:
+            raise RuntimeError(
+                "session was built without dyn=; pass dyn=True (or a "
+                "RepackPolicy / DynGraph) to enable live ingest"
+            )
+        # delta from the DynGraph's own counters: one ingest can fold
+        # MORE than once (staging past capacity repacks mid-batch), so
+        # the final report's mode alone undercounts
+        before_r = self.dyn.stats["repacks"]
+        before_o = self.dyn.stats["overlay_applies"]
+        report = self.dyn.ingest(ops, force_repack=force_repack)
+        self.stats["ingested_ops"] += report.get("staged", 0)
+        self.stats["repacks"] += self.dyn.stats["repacks"] - before_r
+        self.stats["overlay_applies"] += (
+            self.dyn.stats["overlay_applies"] - before_o
+        )
+        if self.dyn.fragment is not self.fragment:
+            self._adopt_fragment()
+        return report
+
+    def _adopt_fragment(self) -> None:
+        """Point the session and every resident worker at the rebuilt
+        fragment.  Stale compiled runners stay in the caches but miss
+        naturally: the apps' re-resolved plan/mirror uids enter the
+        trace key, so the first post-repack query of each shape is a
+        counted compile."""
+        self.fragment = self.dyn.fragment
+        for w in self._workers.values():
+            w.fragment = self.dyn.fragment
+
+    def _ensure_dyn_view(self, app_key: str, w: Worker) -> None:
+        """Apps without an overlay contract (PageRank, host-only
+        loops) must see a consistent graph: fold the pending overlay
+        into the CSR before dispatching them — a counted forced
+        repack, not a silent stale read."""
+        if self.dyn is None or self.dyn.overlay_count == 0:
+            return
+        if getattr(w.app, "dyn_overlay_support", False):
+            return
+        self.dyn.fold_now(
+            reason=f"{app_key} has no dyn-overlay contract"
+        )
+        self.stats["repacks"] += 1
+        self.stats["forced_repacks"] += 1
+        self._adopt_fragment()
 
     # ---- admission --------------------------------------------------------
 
@@ -165,6 +245,21 @@ class ServeSession:
                     request_id=req.id, app_key=req.app_key, ok=False,
                     error={"error": str(e)}, lane=b,
                     batch_size=len(batch),
+                )
+                for b, req in enumerate(batch)
+            ]
+        try:
+            self._ensure_dyn_view(batch[0].app_key, w)
+        except Exception as e:
+            # a failed forced repack (e.g. the fragment was loaded
+            # without retain_edge_list) must not raise out of the
+            # serve loop — the popped requests get error results
+            self.stats["failed"] += len(batch)
+            return [
+                ServeResult(
+                    request_id=req.id, app_key=req.app_key, ok=False,
+                    error={"error": f"{type(e).__name__}: {e}"},
+                    lane=b, batch_size=len(batch),
                 )
                 for b, req in enumerate(batch)
             ]

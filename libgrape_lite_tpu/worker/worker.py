@@ -95,11 +95,21 @@ class Worker:
         self.runner_cache_stats = {"hits": 0, "misses": 0}
         self.rounds = 0
         self._result_state = None
+        # the fragment each result was computed on: query_incremental's
+        # safe prev_fragment default — a serve repack rebinds
+        # self.fragment, but the PREVIOUS result's rows still live in
+        # the old layout and must migrate by oid
+        self._result_fragment = None
         self._terminate_code = 0
         self._guard_monitor = None  # guard/: set only while guards are armed
         self.batch_rounds = None  # per-lane rounds of the last query_batch
         self.batch_terminate = None  # per-lane terminate codes (min(0, v))
         self.batch_breaches = None  # per-lane guard bundles (serve/batch)
+        # dyn/: incremental-IncEval accounting — seeded vs (counted,
+        # never silent) cold fallbacks, and the last query's plan
+        self.inc_stats = {"seeded": 0, "cold": 0}
+        self.inc_report = None
+        self._seed_fn = None  # set only inside query_incremental
 
     @property
     def guard_report(self):
@@ -109,6 +119,34 @@ class Worker:
             None if self._guard_monitor is None
             else self._guard_monitor.report()
         )
+
+    def _seeded(self, state_np):
+        """Apply the incremental-IncEval seed overrides (dyn/) to a
+        freshly-built init state — identity outside query_incremental.
+        The hook sits at every init_state call site, so the seeded
+        query runs the SAME fused/stepwise/guarded machinery as a cold
+        one (and a checkpoint resume restores over the fresh init the
+        usual way: the restored carry came from the seeded run)."""
+        if self._seed_fn is None:
+            return state_np
+        return self._seed_fn(state_np)
+
+    def _check_dyn_view(self):
+        """An app without a dyn-overlay contract must not run while the
+        fragment holds staged delta edges — it would silently compute
+        on the stale base graph.  ServeSession repacks automatically
+        before dispatching such apps; bare Workers fail loudly."""
+        ov = getattr(self.fragment, "dyn_overlay", None)
+        if (
+            ov is not None and ov.count > 0
+            and not getattr(self.app, "dyn_overlay_support", False)
+        ):
+            raise ValueError(
+                f"{type(self.app).__name__} has no dyn-overlay "
+                f"contract and the fragment carries {ov.count} staged "
+                "delta edge(s); fold them first (DynGraph.fold_now — "
+                "ServeSession.ingest handles this automatically)"
+            )
 
     def get_terminate_info(self):
         """(success, info) — reference `Worker::GetTerminateInfo`
@@ -609,6 +647,9 @@ class Worker:
         Guarded batched execution (per-lane monitors, breach isolation)
         is driven by serve/batch.py — `guard` here routes there."""
         self._check_batchable()
+        # BEFORE the guard routing: the guarded batch path must reject
+        # a stale dyn view exactly like the plain one
+        self._check_dyn_view()
         app = self.app
         frag = self.fragment
         mr = app.max_rounds if max_rounds is None else max_rounds
@@ -661,6 +702,7 @@ class Worker:
             if tr.enabled:
                 obs.flush()
         self._result_state = {**out_state, **eph_part}
+        self._result_fragment = self.fragment
         return self._result_state
 
     def batch_lane_state(self, lane: int):
@@ -709,6 +751,7 @@ class Worker:
         from libgrape_lite_tpu.guard.config import GuardConfig
 
         app = self.app
+        self._check_dyn_view()
         if checkpoint_every is not None or checkpoint_dir is not None:
             guard_cfg = GuardConfig.resolve(guard)
             if (
@@ -747,7 +790,8 @@ class Worker:
                         f"{type(app).__name__}"
                     )
             elif hasattr(app, "collect_mutations"):
-                # stepwise handles (and logs) the mutation restriction
+                # MutationContext apps run stepwise with a mutation-
+                # aware monitor (digest history resets at boundaries)
                 return self.query_stepwise(
                     max_rounds, guard=guard, **query_args
                 )
@@ -779,6 +823,7 @@ class Worker:
                 with tr.span("query", mode="host",
                              app=type(app).__name__) as sp:
                     self._result_state = app.host_compute(frag, **kwargs)
+                    self._result_fragment = self.fragment
                     self.rounds = getattr(app, "rounds", 0)
                     self._finish_query_obs(sp)
             finally:
@@ -796,7 +841,9 @@ class Worker:
             # the fused while_loop cannot rebuild the fragment mid-loop
             return self.query_stepwise(max_rounds, **query_args)
 
-        state = self._place_state(app.init_state(frag, **query_args))
+        state = self._place_state(
+            self._seeded(app.init_state(frag, **query_args))
+        )
         runner = self._runner_for(mr, state)
         eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
         carry = {k: v for k, v in state.items() if k not in eph}
@@ -825,7 +872,89 @@ class Worker:
             if tr.enabled:
                 obs.flush()
         self._result_state = out_state
+        self._result_fragment = self.fragment
         return out_state
+
+    def query_incremental(self, prev_result, delta=None,
+                          max_rounds: int | None = None, *,
+                          prev_fragment=None, guard=None,
+                          checkpoint_every: int | None = None,
+                          checkpoint_dir: str | None = None,
+                          fault_plan=None, **query_args):
+        """Incremental IncEval (dyn/, PIE's headline capability): run
+        this query seeded from `prev_result` — the state dict a
+        previous `query` of the SAME app and args returned on the
+        pre-delta graph — re-converging only the region the delta
+        touched instead of recomputing from scratch.
+
+        `delta` describes the staged change (a dyn.DeltaBuffer or its
+        `summary()`); the app's `inc_mode` contract decides the path:
+
+          * "monotone-min" + additive delta -> the carry is seeded with
+            `min(fresh_init, migrated prev)` per `inc_seed_keys` key —
+            EXACT, byte-identical to a cold full query on the mutated
+            graph (the monotone-operator argument lives in
+            dyn/incremental.py), typically in a fraction of the rounds;
+          * anything else -> a cold full query through the same API,
+            counted in `inc_stats["cold"]` — an honest fallback, never
+            a silent wrong answer.
+
+        `prev_fragment` names the fragment `prev_result` was computed
+        on when a repack replaced it (rows migrate by oid, values remap
+        via the app's `inc_value_map`).  Default: the fragment THIS
+        worker's last query ran on (`_result_fragment`) — so the
+        resident-worker pattern (query, session repack rebinds
+        `self.fragment`, query_incremental) migrates correctly without
+        the caller naming the old fragment; a prev_result imported
+        from a DIFFERENT worker across a repack must pass it
+        explicitly (falling back to the current fragment would attach
+        old rows to renumbered vertices).  Composes with guard/ and
+        ft/ exactly like `query` — the seeded run is an ordinary query
+        with a different starting carry, so checkpoints taken inside
+        it resume byte-identically through the mutation boundary."""
+        from libgrape_lite_tpu.dyn.incremental import (
+            incremental_plan,
+            reseed_fold,
+        )
+        from libgrape_lite_tpu.utils import logging as glog
+
+        app = self.app
+        mode, reason = incremental_plan(app, delta)
+        self.inc_report = {"mode": mode, "reason": reason}
+        self.inc_stats[mode] += 1
+        obs.tracer().instant("query_incremental", mode=mode)
+        if mode == "cold":
+            glog.vlog(
+                1, "query_incremental: cold recompute (%s)", reason
+            )
+            return self.query(
+                max_rounds, guard=guard,
+                checkpoint_every=checkpoint_every,
+                checkpoint_dir=checkpoint_dir, fault_plan=fault_plan,
+                **query_args,
+            )
+        prev_frag = (
+            prev_fragment or self._result_fragment or self.fragment
+        )
+        host_prev = {
+            k: np.asarray(jax.device_get(prev_result[k]))
+            for k in app.inc_seed_keys
+            if k in prev_result
+        }
+        self._seed_fn = lambda fresh: {
+            **fresh,
+            **reseed_fold(app, self.fragment, fresh, prev_frag,
+                          host_prev),
+        }
+        try:
+            return self.query(
+                max_rounds, guard=guard,
+                checkpoint_every=checkpoint_every,
+                checkpoint_dir=checkpoint_dir, fault_plan=fault_plan,
+                **query_args,
+            )
+        finally:
+            self._seed_fn = None
 
     def _ledger_brief(self):
         """Scalar totals of the engaged pack ledger (the query span's
@@ -915,7 +1044,9 @@ class Worker:
         if fault_plan.is_noop():
             fault_plan = None
 
-        state = self._place_state(app.init_state(frag, **query_args))
+        state = self._place_state(
+            self._seeded(app.init_state(frag, **query_args))
+        )
         eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
         eph_part = {k: v for k, v in state.items() if k in eph}
 
@@ -1073,6 +1204,7 @@ class Worker:
             if tr.enabled:
                 obs.flush()
         self._result_state = {**carry, **eph_part}
+        self._result_fragment = self.fragment
         return self._result_state
 
     def _place_state(self, state_np):
@@ -1187,6 +1319,9 @@ class Worker:
         on the first round) and `device_wait_us` (the device-execution
         estimate).  Reported vlog times follow the same synced
         interval."""
+        # public entry point too (profiling surface): an uncontracted
+        # app must fail loudly on a staged dyn view here as well
+        self._check_dyn_view()
         tr = obs.tracer()
         if not tr.enabled:
             return self._query_stepwise_impl(
@@ -1274,7 +1409,7 @@ class Worker:
         guard_cfg = GuardConfig.resolve(guard)
         self._guard_monitor = None
 
-        state_np = app.init_state(frag, **query_args)
+        state_np = self._seeded(app.init_state(frag, **query_args))
         eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
         ckpt = None
         resume_meta = None
@@ -1345,23 +1480,25 @@ class Worker:
 
         monitor = None
         if guard_cfg.enabled:
-            if has_mutations:
-                glog.log_info(
-                    "guard: disabled for MutationContext apps (the "
-                    "fragment changes between rounds, so a probe cannot "
-                    "span a rebuild)"
-                )
-            else:
-                from libgrape_lite_tpu.guard.monitor import GuardMonitor
+            from libgrape_lite_tpu.guard.monitor import GuardMonitor
 
-                monitor = GuardMonitor(
-                    app=app, frag=frag, config=guard_cfg, ckpt=ckpt,
-                    ledger=self.pack_ledger(),
-                )
-                self._guard_monitor = monitor
+            monitor = GuardMonitor(
+                app=app, frag=frag, config=guard_cfg, ckpt=ckpt,
+                ledger=self.pack_ledger(),
+            )
+            self._guard_monitor = monitor
+            glog.vlog(
+                1, "guard: stepwise probes every %d round(s) "
+                "(policy=%s)", guard_cfg.every, guard_cfg.policy,
+            )
+            if has_mutations:
+                # MutationContext apps guard too (dyn/): each mutation
+                # boundary resets the watchdog digest history and
+                # re-resolves the probe — a pre-mutation digest match
+                # proves nothing about the REBUILT graph's operator
                 glog.vlog(
-                    1, "guard: stepwise probes every %d round(s) "
-                    "(policy=%s)", guard_cfg.every, guard_cfg.policy,
+                    1, "guard: mutation-aware — digest history resets "
+                    "at every mutation boundary",
                 )
 
         # the monotone invariants compare against the carry of the LAST
@@ -1458,6 +1595,9 @@ class Worker:
             if changed:
                 # the rebuilt state carries fresh ephemeral leaves
                 eph_vals = {k: state[k] for k in eph}
+                if monitor is not None:
+                    monitor.on_mutation(frag, self.pack_ledger())
+                    guard_prev = carry_of(state)
             if changed and int(active) >= 0:
                 active = 1
         try:
@@ -1531,6 +1671,13 @@ class Worker:
                     )
                     if changed:
                         eph_vals = {k: state[k] for k in eph}
+                        if monitor is not None:
+                            # the graph (and its superstep operator)
+                            # changed: digest history no longer proves
+                            # cycles, monotone comparisons must not
+                            # span the rebuild
+                            monitor.on_mutation(frag, self.pack_ledger())
+                            guard_prev = carry_of(state)
                     if changed and int(active) >= 0:
                         active = 1  # the new topology must be re-evaluated
                         if rounds >= mr:
@@ -1547,6 +1694,7 @@ class Worker:
         self.rounds = rounds
         self._terminate_code = min(0, int(active))
         self._result_state = state
+        self._result_fragment = self.fragment
         return state
 
     def pack_ledger(self):

@@ -126,6 +126,39 @@ assert sum(rec["batch_hist"].values()) >= 4, rec["batch_hist"]
 print(f"  OK (32 queries, {rec['qps']} q/s, hist {rec['batch_hist']})")
 EOF
 
+echo "== dyn: ingest a delta stream while a mixed query stream runs (fnum=2) =="
+# streaming smoke (dyn/): 10 additive delta ops ingested in chunks
+# between query batches — they ride the overlay side-path (no repack
+# below the threshold) while 16 sssp + 8 bfs queries stay live
+python - > "$OUT/dyn_delta.txt" <<'EOF'
+for i in range(10):
+    print("a 6", 200 + 17 * i, "0.5")
+EOF
+python - > "$OUT/dyn_stream.txt" <<'EOF'
+for i in range(16):
+    print("sssp", 6 + i)
+for i in range(8):
+    print("bfs", 6 + i)
+EOF
+python -m libgrape_lite_tpu.cli serve \
+  --efile "$DS/p2p-31.e" --vfile "$DS/p2p-31.v" $PLATFORM_ARGS --fnum 2 \
+  --stream "$OUT/dyn_stream.txt" --max_batch 8 \
+  --delta_stream "$OUT/dyn_delta.txt" --ingest_every 8 \
+  --dyn_repack_ratio 0.5 > "$OUT/dyn_serve.json"
+python - "$OUT/dyn_serve.json" <<'EOF'
+import json, sys
+rec = json.loads(
+    [l for l in open(sys.argv[1]) if l.startswith("{")][-1])
+assert rec["queries"] == 24 and rec["failed"] == 0, rec
+d = rec["dyn"]
+assert d["ingested"] == 10 and d["repack_count"] == 0, d
+assert d["overlay_applies"] >= 1 and d["updates_per_s"] > 0, d
+assert d["queries_ok"] == 24, d
+print(f"  OK (24 queries live, {d['ingested']} ops ingested at "
+      f"{d['updates_per_s']} upd/s, {d['overlay_applies']} overlay "
+      "applies, 0 repacks)")
+EOF
+
 echo "== BENCH record schema (fresh small-scale bench incl. serve block + archived r05) =="
 GRAPE_BENCH_SCALE=10 GRAPE_BENCH_NO_PROBE=1 GRAPE_BENCH_NO_LEDGER=1 \
   GRAPE_BENCH_NO_GUARD=1 python bench.py > "$OUT/bench.json" 2>/dev/null

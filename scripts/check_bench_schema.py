@@ -41,6 +41,7 @@ _TOP = {
     "pack_ledger": (dict, False),
     "obs": (dict, False),
     "serve": (dict, False),
+    "dyn": (dict, False),
 }
 
 _SSSP = {
@@ -99,6 +100,21 @@ _SERVE_POINT = {
     "ok": (int, True),
 }
 
+# the r10 dynamic-graph lane (dyn/, docs/DYNAMIC_GRAPHS.md): updates
+# ingested per second while a query stream stays live, repack vs
+# overlay counts, and the incremental-vs-cold round/wall comparison
+_DYN = {
+    "updates_per_s": (_NUM, True),
+    "ingested": (int, True),
+    "repack_count": (int, True),
+    "overlay_applies": (int, True),
+    "queries": (int, True),
+    "queries_ok": (int, True),
+    "inc_cold_rounds": (int, False),
+    "inc_seeded_rounds": (int, False),
+    "inc_speedup": (_NUM, False),
+}
+
 _SPAN_ROLLUP = {
     "count": (int, True),
     "total_s": (_NUM, True),
@@ -113,6 +129,7 @@ SCHEMA = {
     "pack_ledger": _PACK_LEDGER,
     "obs": _OBS,
     "serve": _SERVE,
+    "dyn": _DYN,
 }
 
 
@@ -155,7 +172,7 @@ def validate_record(record) -> list:
     _check_block(record, _TOP, "record", errors)
     for key, spec in (("sssp", _SSSP), ("guard", _GUARD),
                       ("pack_ledger", _PACK_LEDGER), ("obs", _OBS),
-                      ("serve", _SERVE)):
+                      ("serve", _SERVE), ("dyn", _DYN)):
         block = record.get(key)
         if isinstance(block, dict):
             _check_block(block, spec, key, errors)
@@ -266,7 +283,8 @@ def main(argv=None) -> int:
                     print(f"  - {e}")
             else:
                 blocks = [k for k in ("sssp", "guard", "pack_ledger",
-                                      "obs", "serve") if k in record]
+                                      "obs", "serve", "dyn")
+                          if k in record]
                 print(f"OK {label} ({record.get('metric')}"
                       + (f"; blocks: {', '.join(blocks)}" if blocks
                          else "") + ")")

@@ -10,6 +10,13 @@ writer stays fast).  The CSV WRITE is chunked (bounded text buffers);
 generation itself materialises the full src/dst int64 arrays plus a
 per-bit float64 draw, so peak memory is ~5x the edge-array bytes
 (scale 24 x ef 16: ~20 GiB).
+
+`--delta N` additionally emits a reproducible update stream of N
+`a src dst [w]` lines to `--delta_out` (dyn/ docs/DYNAMIC_GRAPHS.md):
+fresh RMAT draws over the SAME vertex universe with a separate seed —
+additive-only, so they ride the overlay side-path; the serve CLI
+ingests the file via --delta_stream and bench.py's dyn lane measures
+updates/sec against exactly this distribution.
 """
 
 from __future__ import annotations
@@ -32,7 +39,15 @@ def main(argv=None) -> int:
     p.add_argument("--weighted", action="store_true")
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--out", required=True)
+    p.add_argument("--delta", type=int, default=0,
+                   help="also emit N additive delta ops ('a src dst "
+                        "[w]' lines) to --delta_out")
+    p.add_argument("--delta_out", default="",
+                   help="path for the --delta update stream")
+    p.add_argument("--delta_seed", type=int, default=101)
     args = p.parse_args(argv)
+    if args.delta and not args.delta_out:
+        p.error("--delta requires --delta_out")
 
     from bench import rmat_edges
 
@@ -58,7 +73,39 @@ def main(argv=None) -> int:
     print(f"[gen_rmat] wrote {args.out} "
           f"({os.path.getsize(args.out) / (1 << 30):.2f} GiB) in "
           f"{time.perf_counter() - t0:.1f}s", flush=True)
+
+    if args.delta:
+        t0 = time.perf_counter()
+        d_src, d_dst = delta_edges(args.scale, args.delta,
+                                   args.delta_seed)
+        rng_dw = np.random.default_rng(args.delta_seed + 1)
+        with open(args.delta_out, "w") as f:
+            if args.weighted:
+                dw = rng_dw.integers(1, 11, args.delta)
+                for s, d, x in zip(d_src.tolist(), d_dst.tolist(),
+                                   dw.tolist()):
+                    f.write(f"a {s} {d} {x}\n")
+            else:
+                for s, d in zip(d_src.tolist(), d_dst.tolist()):
+                    f.write(f"a {s} {d}\n")
+        print(f"[gen_rmat] wrote {args.delta} delta op(s) to "
+              f"{args.delta_out} in {time.perf_counter() - t0:.1f}s",
+              flush=True)
     return 0
+
+
+def delta_edges(scale: int, n_ops: int, seed: int):
+    """Reproducible additive update stream: RMAT draws over the same
+    2^scale vertex universe with an independent seed — shared with
+    bench.py's dyn lane so the measured distribution IS the scripted
+    one."""
+    from bench import rmat_edges
+
+    # rmat_edges draws scale*edge_factor-sized arrays; generate the
+    # smallest RMAT batch covering n_ops and slice
+    ef = max(1, -(-n_ops // (1 << scale)))
+    _, src, dst = rmat_edges(scale, ef, seed)
+    return src[:n_ops], dst[:n_ops]
 
 
 if __name__ == "__main__":

@@ -68,6 +68,22 @@ def test_mutable_wcc(mutated_cache, fnum):
     wcc_verify(res, load_golden(dataset_path("p2p-31-WCC")))
 
 
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_mutable_cdlp(mutated_cache, fnum):
+    from libgrape_lite_tpu.models import CDLP
+
+    res = run_worker(CDLP(), mutated_cache(fnum), max_round=10)
+    exact_verify(res, load_golden(dataset_path("p2p-31-CDLP")))
+
+
+@pytest.mark.parametrize("fnum", FNUMS)
+def test_mutable_lcc(mutated_cache, fnum):
+    from libgrape_lite_tpu.models import LCC
+
+    res = run_worker(LCC(), mutated_cache(fnum))
+    eps_verify(res, load_golden(dataset_path("p2p-31-LCC")))
+
+
 def test_staged_mutator_api():
     """MutationContext-style staged ops on a tiny graph."""
     from libgrape_lite_tpu.fragment.mutation import BasicFragmentMutator
