@@ -1,0 +1,107 @@
+"""grape-lint: static contract linter + compiled-artifact auditor.
+
+The compile-time complement to guard/ (which proves invariants at
+runtime): Layer 1 AST lints (R1-R5, analysis/astlint.py) make the bug
+classes earlier review passes caught by hand un-shippable — baked
+closure constants, per-dispatch re-jits, incomplete cache keys, query
+entrypoints that skip the dyn stale-view check, eager hot-loop
+logging; Layer 2 artifact audits (A1-A3, analysis/artifact.py)
+recount the same contracts from the actually-lowered/compiled runners
+and the live XLA compile stream.  Intentional exceptions are named in
+analysis/baseline.json, never invisible.
+
+Surfaces: `python -m libgrape_lite_tpu.cli lint`,
+`scripts/grape_lint.py [--json] [--artifact]`, and
+`analysis.compile_events()` for zero-recompile test pins.
+docs/STATIC_ANALYSIS.md is the user guide.
+"""
+
+from libgrape_lite_tpu.analysis.artifact import (
+    CompileEvents,
+    compile_events,
+    run_artifact_audit,
+    scan_constants,
+    warm_matrix_audit,
+)
+from libgrape_lite_tpu.analysis.astlint import (
+    lint_paths,
+    lint_source,
+    repo_root,
+)
+from libgrape_lite_tpu.analysis.report import (
+    Baseline,
+    DEFAULT_BASELINE,
+    Finding,
+    build_report,
+    render_text,
+    split_by_baseline,
+    stale_suppressions,
+    validate_lint_report,
+)
+from libgrape_lite_tpu.analysis.rules import RULES
+
+__all__ = [
+    "Baseline",
+    "CompileEvents",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "RULES",
+    "build_report",
+    "compile_events",
+    "lint_paths",
+    "lint_source",
+    "render_text",
+    "repo_root",
+    "run_artifact_audit",
+    "run_lint",
+    "scan_constants",
+    "split_by_baseline",
+    "stale_suppressions",
+    "validate_lint_report",
+    "warm_matrix_audit",
+]
+
+
+def run_lint(paths=None, *, baseline_path=None, artifact: bool = False,
+             root=None):
+    """One linter invocation: (report_dict, exit_code).  Default scope
+    is the shipped package tree; exit code is nonzero when any
+    unsuppressed finding survives the baseline — the CI gate
+    scripts/app_tests.sh enforces."""
+    import os
+
+    if root is None:
+        root = repo_root()
+    default_scope = not paths
+    if default_scope:
+        paths = [os.path.join(root, "libgrape_lite_tpu")]
+    findings = lint_paths(paths, root=root)
+    baseline = Baseline.load(baseline_path)
+    art = None
+    art_findings = []
+    if artifact:
+        art_findings, art = run_artifact_audit()
+        findings = list(findings) + art_findings
+    live, quiet = split_by_baseline(findings, baseline)
+    if art is not None:
+        # keep the artifact block's own findings list consistent with
+        # the baseline verdicts above — one defect must not render as
+        # live in one half of the record and suppressed in the other
+        quiet_fps = {f.fingerprint for f in quiet}
+        art["findings"] = [
+            f.to_dict(f.fingerprint in quiet_fps) for f in art_findings
+        ]
+    # staleness is only provable on the default full-tree scope (a
+    # single-file run legitimately matches almost no entries); there,
+    # a baseline entry or budget unit no finding consumed fails the
+    # gate — a retired defect must retire its named exception, or the
+    # stale entry green-gates the defect's reintroduction
+    stale = stale_suppressions(
+        baseline, quiet, include_artifact=artifact,
+    ) if default_scope else []
+    report = build_report(
+        live, quiet, root=root,
+        baseline_path=baseline.path or DEFAULT_BASELINE,
+        artifact=art, stale=stale,
+    )
+    return report, (0 if report["ok"] else 1)

@@ -8,6 +8,12 @@ serving runtime instead (serve/, docs/SERVING.md): load the graph
 once, pump a scripted query stream through the admission queue with
 vmapped multi-source batching, and print one JSON summary line
 (queries, qps, p50/p99 latency, batch-size histogram).
+
+`python -m libgrape_lite_tpu.cli lint ...` runs grape-lint
+(analysis/, docs/STATIC_ANALYSIS.md): the AST contract rules R1-R5
+over the library tree (or explicit paths), optionally the
+compiled-artifact audits (--artifact), against the suppression
+baseline — exits nonzero on any unsuppressed finding.
 """
 
 from __future__ import annotations
@@ -136,6 +142,101 @@ def make_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--platform", default="")
     p.add_argument("--cpu_devices", type=int, default=0)
     return p
+
+
+def make_lint_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="libgrape_lite_tpu lint")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the "
+                        "installed libgrape_lite_tpu tree)")
+    p.add_argument("--json", action="store_true",
+                   help="print the structured report (schema-checked "
+                        "against analysis/report.py before printing, "
+                        "check_bench_schema discipline)")
+    p.add_argument("--baseline", default="",
+                   help="suppression baseline path (default: "
+                        "analysis/baseline.json)")
+    p.add_argument("--artifact", action="store_true",
+                   help="also run the compiled-artifact audits "
+                        "(A1 constant bloat / A2 donation / A3 "
+                        "zero-compile warm matrix) — compiles small "
+                        "canonical runners, so it needs a working "
+                        "jax backend")
+    p.add_argument("--update-baseline", default=None, metavar="REASON",
+                   help="suppress every CURRENT unsuppressed AST "
+                        "finding into the baseline with this reason "
+                        "string (exceptions are named, not invisible)")
+    p.add_argument("--platform", default="",
+                   help="jax platform override for --artifact")
+    return p
+
+
+def lint_main(argv=None) -> int:
+    """The `lint` subcommand; returns the process exit code (nonzero
+    on any unsuppressed finding — the CI gate app_tests.sh enforces)."""
+    import json as _json
+    import sys
+
+    ns = make_lint_parser().parse_args(argv)
+    _apply_platform(ns.platform, 0)
+
+    from libgrape_lite_tpu import analysis
+
+    if ns.update_baseline is not None:
+        import os
+
+        if not ns.update_baseline:
+            # an empty reason (e.g. an unset shell variable) must not
+            # silently degrade to a plain lint run — the mandatory-
+            # reason contract Baseline.add enforces starts HERE
+            print(
+                "grape-lint: --update-baseline needs a non-empty "
+                "REASON — exceptions are named, not invisible",
+                file=sys.stderr,
+            )
+            return 2
+
+        paths = ns.paths or [
+            os.path.join(analysis.repo_root(), "libgrape_lite_tpu")
+        ]
+        try:
+            findings = analysis.lint_paths(paths)
+        except FileNotFoundError as e:
+            print(f"grape-lint: {e}", file=sys.stderr)
+            return 2
+        baseline = analysis.Baseline.load(ns.baseline or None)
+        live, _ = analysis.split_by_baseline(findings, baseline)
+        for f in live:
+            baseline.add(f, ns.update_baseline)
+        path = baseline.save()
+        print(f"baseline: {len(live)} suppression(s) added -> {path}")
+        return 0
+
+    try:
+        report, rc = analysis.run_lint(
+            ns.paths, baseline_path=ns.baseline or None,
+            artifact=ns.artifact,
+        )
+    except FileNotFoundError as e:
+        print(f"grape-lint: {e}", file=sys.stderr)
+        return 2
+    if ns.json:
+        errors = analysis.validate_lint_report(report)
+        if errors:
+            # the report record is a pinned artifact like the BENCH
+            # json: schema drift fails AFTER the findings are shown
+            print(_json.dumps(report), flush=True)
+            for e in errors:
+                print(f"lint-report schema: {e}", file=sys.stderr)
+            return 3
+        print(_json.dumps(report), flush=True)
+    else:
+        live = [analysis.Finding(**{k: f[k] for k in (
+            "rule", "path", "line", "symbol", "message")})
+            for f in report["findings"] if not f["suppressed"]]
+        quiet = [f for f in report["findings"] if f["suppressed"]]
+        print(analysis.render_text(live, quiet, report.get("stale")))
+    return rc
 
 
 def _apply_platform(platform: str, cpu_devices: int) -> None:
@@ -327,6 +428,10 @@ def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
         return serve_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # returned (not sys.exit'd) so programmatic callers get the
+        # code; the module tail exits with it
+        return lint_main(argv[1:])
     ns = make_parser().parse_args(argv)
     _apply_platform(ns.platform, ns.cpu_devices)
     args = QueryArgs(
@@ -337,4 +442,6 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())

@@ -105,8 +105,8 @@ def _validate_load(frag: ShardedEdgecutFragment) -> ShardedEdgecutFragment:
 
     glog.vlog(
         1,
-        f"load validation: {len(sides) * frag.fnum} CSR(s) structurally "
-        "sound",
+        "load validation: %d CSR(s) structurally sound",
+        len(sides) * frag.fnum,
     )
     return frag
 

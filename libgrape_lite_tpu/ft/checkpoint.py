@@ -356,7 +356,7 @@ class CheckpointManager:
         try:
             steps = list_checkpoints(self.directory)
         except OSError as e:  # pragma: no cover - listdir race
-            glog.vlog(1, f"checkpoint gc: listing failed ({e}); skipping")
+            glog.vlog(1, "checkpoint gc: listing failed (%s); skipping", e)
             return
         for _, path in steps[: max(0, len(steps) - self.keep)]:
             # ignore_errors: the entry may already be gone

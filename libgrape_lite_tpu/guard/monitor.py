@@ -24,6 +24,7 @@ identically — they halt (or warn) instead.
 
 from __future__ import annotations
 
+import weakref
 from dataclasses import asdict, dataclass
 from typing import Dict, List, Optional
 
@@ -39,8 +40,21 @@ from libgrape_lite_tpu.guard.watchdog import (
     digest_hex,
 )
 from libgrape_lite_tpu.utils import logging as glog
+from libgrape_lite_tpu.utils.types import state_struct
 
 _HISTORY = 64  # rounds of digest/active context kept for the bundle
+
+# compiled probes shared across monitors: a GuardMonitor is created
+# per query — and per LANE per batch in serve/batch.py — so holding
+# the jitted probe on the instance re-traced and re-compiled it for
+# every guarded dispatch (jit caches by wrapper identity; the wrapper
+# was new each time).  The cache is keyed weakly on the fragment
+# (probes bind invariants resolved against it) and strongly on (app
+# class, app.trace_key(), carry structure) — the same identity the
+# worker's runner cache uses; a repack/mutation swaps the fragment
+# and naturally starts a fresh entry.  Found by grape-lint R2, the
+# PR 6 guarded-serve re-jit class (analysis/rules.py).
+_PROBE_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 class GuardError(RuntimeError):
@@ -135,6 +149,23 @@ class GuardMonitor:
         )
 
     def _resolve(self, carry: Dict) -> None:
+        cache = _PROBE_CACHE.setdefault(self.frag, {})
+        key = (
+            type(self.app).__qualname__,
+            self.app.trace_key(),
+            state_struct(carry),
+        )
+        hit = cache.get(key)
+        if hit is None:
+            hit = self._build_probe(carry)
+            cache[key] = hit
+        self._invariants, self._probe, self._probe_inv = hit
+
+    def _build_probe(self, carry: Dict):
+        """(kept invariants, jitted probe, jitted invariants-only
+        probe or None) — built once per (fragment, app class +
+        hyperparameters, carry structure) and shared through
+        _PROBE_CACHE across every monitor of that identity."""
         declared = self.app.invariants(self.frag, carry)
         kept, dropped = [], []
         for inv in declared:
@@ -144,7 +175,6 @@ class GuardMonitor:
                 "guard: dropped invariants whose carry keys are absent: "
                 + ", ".join(i.name for i in dropped)
             )
-        self._invariants = kept
         float_keys = sorted(
             k for k in carry if np.dtype(carry[k].dtype).kind == "f"
         )
@@ -154,7 +184,7 @@ class GuardMonitor:
         # probe executable as XLA constants
         def inv_part(dev, prev, cur):
             oks, vals = [], []
-            for inv in self._invariants:
+            for inv in kept:
                 ok, val = inv.check(dev, prev, cur)
                 oks.append(ok)
                 vals.append(val)
@@ -188,12 +218,11 @@ class GuardMonitor:
                 residual = jnp.max(jnp.stack(diffs))
             return oks, vals, digest, residual
 
-        self._probe = jax.jit(probe)
         # invariants-only probe for callers that already hold the
         # digest/residual (the guarded-fused chunk runner emits them
         # as extra loop outputs); apps with no invariants then skip
         # the probe dispatch entirely
-        self._probe_inv = jax.jit(inv_part) if kept else None
+        return kept, jax.jit(probe), (jax.jit(inv_part) if kept else None)
 
     # ---- per-probe entry point ------------------------------------------
 

@@ -197,4 +197,8 @@ def run_guarded_batch(worker, args_list, mr: int, guard_cfg, *,
         if tr.enabled:
             obs.flush()
     worker._result_state = {**carry, **eph_part}
+    # same provenance record as the unguarded paths: a serve repack
+    # rebinds worker.fragment, and query_incremental's prev_fragment
+    # default must name the fragment THIS result's rows live in
+    worker._result_fragment = frag
     return worker._result_state

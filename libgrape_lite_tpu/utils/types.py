@@ -74,3 +74,16 @@ VID64_DTYPE = np.int64
 
 def is_empty_type(t) -> bool:
     return t is EmptyType or isinstance(t, EmptyType) or t is None
+
+
+def state_struct(state) -> tuple:
+    """Sorted (key, shape, dtype) structural identity of a query
+    state/carry dict — the cache-key component shared by the worker
+    runner cache (Worker._state_struct) and the guard probe cache
+    (guard/monitor._PROBE_CACHE).  One definition, so the two caches
+    can never disagree about what "same structure" means."""
+    return tuple(
+        sorted(
+            (k, tuple(v.shape), str(v.dtype)) for k, v in state.items()
+        )
+    )

@@ -159,6 +159,20 @@ print(f"  OK (24 queries live, {d['ingested']} ops ingested at "
       "applies, 0 repacks)")
 EOF
 
+echo "== grape-lint: static contract rules, zero unsuppressed findings =="
+# the AST gate (R1-R5, analysis/): exits 1 on any finding the
+# baseline does not name, 3 if the --json record drifts from its own
+# declared schema — both fail this harness (set -e)
+python scripts/grape_lint.py --json > "$OUT/lint.json"
+python - "$OUT/lint.json" <<'EOF'
+import json, sys
+rec = json.load(open(sys.argv[1]))
+assert rec["ok"], rec["findings"]
+live = [f for f in rec["findings"] if not f["suppressed"]]
+assert live == [], live
+print(f"  OK (clean; {rec['suppressed']} named suppression(s))")
+EOF
+
 echo "== BENCH record schema (fresh small-scale bench incl. serve block + archived r05) =="
 GRAPE_BENCH_SCALE=10 GRAPE_BENCH_NO_PROBE=1 GRAPE_BENCH_NO_LEDGER=1 \
   GRAPE_BENCH_NO_GUARD=1 python bench.py > "$OUT/bench.json" 2>/dev/null

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""grape-lint CI entry point (analysis/, docs/STATIC_ANALYSIS.md).
+
+Thin wrapper over `python -m libgrape_lite_tpu.cli lint` so CI and
+shell hooks have a stable script path next to the other gates:
+
+    python scripts/grape_lint.py                 # AST rules, text report
+    python scripts/grape_lint.py --json          # structured report
+    python scripts/grape_lint.py --artifact      # + compiled-artifact
+                                                 #   audits (A1/A2/A3)
+
+Exit codes: 0 clean (baseline suppressions allowed), 1 unsuppressed
+finding(s), 3 the --json report itself failed its declared schema
+(analysis/report.py validate_lint_report — the same pinned-artifact
+contract scripts/check_bench_schema.py applies to BENCH records).
+
+scripts/app_tests.sh runs the AST gate on every CI pass;
+scripts/tpu_first_light.sh adds --artifact so the first real-TPU
+session also proves no baked constants / surprise compiles on device.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from libgrape_lite_tpu.cli import lint_main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(lint_main(sys.argv[1:]))

@@ -26,6 +26,17 @@ assert d and d[0].platform != 'cpu', f'CPU fallback: {d}'
   exit 1
 fi
 
+echo "== grape-lint artifact audit (no baked constants / surprise
+compiles ON DEVICE — the A1/A3 contracts proven against real TPU
+lowering, not the CPU fallback; docs/STATIC_ANALYSIS.md) =="
+if ! timeout 900 python scripts/grape_lint.py --artifact --json \
+    > "$OUT/lint_artifact.json" 2> "$OUT/lint_artifact.err"; then
+  echo "GRAPE-LINT ARTIFACT AUDIT FAILED (see $OUT/lint_artifact.json" \
+       "— a baked constant or surprise compile on device)" >&2
+  tail -5 "$OUT/lint_artifact.err" >&2
+  exit 1
+fi
+
 echo "== primitive rates (prices the sublane dynamic_gather — the
 cost-model unknown; see docs/PERF_NOTES.md r4 section) =="
 timeout 900 python scripts/pallas_probe.py 2> "$OUT/probe.err" | tee "$OUT/probe.json" || true

@@ -333,11 +333,17 @@ def test_session_ingest_below_threshold_zero_recompile(monkeypatch):
 
     rep = sess.ingest([("a", 0, 600, 0.001), ("a", 600, 650, 0.001)])
     assert rep["mode"] == "overlay"
-    r2 = sess.serve([("sssp", {"source": 0})])
+    # zero XLA compilation pinned on the real compile stream
+    # (analysis.compile_events) — the counter a per-dispatch re-jit
+    # cannot hide from — while the pack counters keep proving zero
+    # REPLANNING (planning is host work, invisible to compile events)
+    from libgrape_lite_tpu.analysis import compile_events
+
+    with compile_events() as ev:
+        r2 = sess.serve([("sssp", {"source": 0})])
     assert r2[0].ok, r2[0].error
+    assert ev.compiles == 0, ("ingest caused a recompile", ev.events)
     s2 = sess.cache_stats()
-    assert s2["runner"]["misses"] == s1["runner"]["misses"], (
-        "ingest caused a recompile", s1, s2)
     assert s2["runner"]["hits"] > s1["runner"]["hits"]
     assert s2["pack"]["planned"] == s1["pack"]["planned"], (
         "ingest re-ran the pack planner", s1, s2)
