@@ -190,6 +190,138 @@ def build_bench_weighted_fragment(src, dst, comm_spec, vm,
     )
 
 
+def pipeline_lane(scale: int) -> dict:
+    """The superstep-pipelining A/B (r9, parallel/pipeline.py): serial
+    vs pipelined wall on a weighted-SSSP RMAT twin at fnum>=2, with
+    the byte-identity verdict, the plan's modeled hidden-exchange
+    fraction and boundary-set sizes, and the cost model's independent
+    overlap recount (drift gated like the op-budget ledger).
+
+    The lane FORCES engagement (GRAPE_PIPELINE=force): the A/B is the
+    point, and on small CPU-fallback twins the auto byte threshold
+    would correctly decline — that gate has its own tests
+    (tests/test_pipeline.py).  Runs in-process when the active backend
+    already spans >=2 devices; main() re-invokes it in a forced
+    2-device CPU subprocess otherwise (`bench.py --pipeline-lane N`)."""
+    import jax
+
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.partitioner import (
+        SegmentedPartitioner,
+    )
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    fnum = min(jax.device_count(), 4)
+    if fnum < 2:
+        raise RuntimeError("pipeline lane needs >= 2 devices")
+    n, src, dst = rmat_edges(scale, EDGE_FACTOR)
+    comm_spec = CommSpec(fnum=fnum)
+    oids = np.arange(n, dtype=np.int64)
+    vm = VertexMap.build(oids, SegmentedPartitioner(fnum, oids))
+    rng_w = np.random.default_rng(11)
+    w = rng_w.uniform(0.1, 10.0, size=len(src)).astype(np.float32)
+    frag = ShardedEdgecutFragment.build(
+        comm_spec, vm, src, dst, w, directed=False,
+        load_strategy=LoadStrategy.kBothOutIn,
+    )
+
+    def best_of(pipe: str, n_meas: int = 3):
+        prev = os.environ.get("GRAPE_PIPELINE")
+        os.environ["GRAPE_PIPELINE"] = pipe
+        try:
+            app = SSSP()
+            worker = Worker(app, frag)
+            worker.query(source=0)  # warm (compile + plan)
+            best = float("inf")
+            for _ in range(n_meas):
+                t0 = time.perf_counter()
+                worker.query(source=0)
+                best = min(best, time.perf_counter() - t0)
+            return best, worker.result_values().tobytes(), app
+        finally:
+            if prev is None:
+                os.environ.pop("GRAPE_PIPELINE", None)
+            else:
+                os.environ["GRAPE_PIPELINE"] = prev
+
+    t_serial, bytes_serial, _ = best_of("0")
+    t_pipe, bytes_pipe, app = best_of("force")
+    plan = getattr(app, "_pipeline", None)
+    if plan is None:
+        # forced and still declined: surface the recorded reason (the
+        # parent gates on engaged=false — a vacuous serial-vs-serial
+        # A/B must never read as a green pipeline verdict)
+        from libgrape_lite_tpu.parallel.pipeline import PIPELINE_STATS
+
+        print(
+            f"[bench] pipeline: declined under force: "
+            f"{PIPELINE_STATS['last_decision']}",
+            file=sys.stderr,
+        )
+    block = {
+        "scale": scale,
+        "fnum": fnum,
+        "app": "sssp",
+        "engaged": plan is not None,
+        "mode": plan.mode if plan is not None else "none",
+        "serial_s": round(t_serial, 4),
+        "pipelined_s": round(t_pipe, 4),
+        "byte_identical": bytes_pipe == bytes_serial,
+        "modeled_hidden_frac": 0.0,
+        "exchange_bytes": 0,
+        "boundary_vertices": 0,
+        "interior_vertices": 0,
+        "boundary_edges": 0,
+        "interior_edges": 0,
+        "overlap_recount_mismatch": 0.0,
+    }
+    if plan is not None:
+        brief = plan.span_brief()
+        for k in ("modeled_hidden_frac", "exchange_bytes",
+                  "boundary_vertices", "interior_vertices",
+                  "boundary_edges", "interior_edges"):
+            block[k] = brief[k]
+        scripts = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts")
+        if scripts not in sys.path:
+            sys.path.insert(0, scripts)
+        from pack_cost_model import overlap_recount
+
+        block["overlap_recount_mismatch"] = (
+            overlap_recount(plan)["overlap_recount_mismatch"]
+        )
+    return block
+
+
+def _pipeline_lane_subprocess(scale: int) -> dict:
+    """Run the lane in a fresh CPU process with a forced 2-device host
+    platform (the CPU-fallback bench itself holds a 1-device backend,
+    and the device count is frozen at backend init)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--pipeline-lane", str(scale)],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"pipeline-lane subprocess failed: {r.stderr.strip()[-500:]}"
+        )
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 _SCHEMA_ERRORS: list = []
 _VALIDATE_RECORD = None
 
@@ -665,6 +797,62 @@ def main():
             print(f"[bench] dyn lane failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
 
+    # superstep-pipelining lane (r9, ROADMAP item 3): serial vs
+    # pipelined wall at fnum>=2 with the byte-identity verdict, the
+    # modeled hidden-exchange fraction, the boundary-set sizes and the
+    # cost model's overlap recount (parallel/pipeline.py,
+    # docs/PIPELINE.md).  The fnum=1 bench backend can't host the A/B,
+    # so the CPU fallback re-invokes the lane in a forced 2-device
+    # subprocess.  GRAPE_BENCH_NO_PIPELINE=1 skips;
+    # GRAPE_BENCH_PIPELINE_SCALE sizes the twin.
+    pipeline_mismatch = None
+    if not os.environ.get("GRAPE_BENCH_NO_PIPELINE"):
+        try:
+            pipe_scale = int(os.environ.get(
+                "GRAPE_BENCH_PIPELINE_SCALE", min(SCALE, 12)))
+            if jax.device_count() >= 2:
+                pipe_block = pipeline_lane(pipe_scale)
+            else:
+                pipe_block = _pipeline_lane_subprocess(pipe_scale)
+            record["pipeline"] = pipe_block
+            _emit_record(record)
+            print(
+                f"[bench] pipeline: serial={pipe_block['serial_s']}s "
+                f"pipelined={pipe_block['pipelined_s']}s "
+                f"byte_identical={pipe_block['byte_identical']} "
+                f"hidden_frac={pipe_block['modeled_hidden_frac']} "
+                f"({pipe_block['boundary_vertices']} boundary / "
+                f"{pipe_block['interior_vertices']} interior vertices)",
+                file=sys.stderr,
+            )
+            # the SAME tolerance as the op-budget ledger gate (the
+            # docs declare them identical — no private constant copy)
+            scripts = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "scripts")
+            if scripts not in sys.path:
+                sys.path.insert(0, scripts)
+            from pack_cost_model import MISMATCH_TOLERANCE as _TOL
+
+            if pipe_block["overlap_recount_mismatch"] > _TOL:
+                pipeline_mismatch = pipe_block["overlap_recount_mismatch"]
+            if not pipe_block["byte_identical"]:
+                pipeline_mismatch = 1.0
+            if not pipe_block["engaged"]:
+                # the lane FORCES engagement, so engaged=false is a
+                # regression that silently disabled pipelining — the
+                # vacuously-identical A/B must not read as green
+                pipeline_mismatch = 1.0
+                print(
+                    "[bench] pipeline: lane ran FORCED but the plan "
+                    "did not engage — see the decline reason above",
+                    file=sys.stderr,
+                )
+        except Exception as e:  # the lane must not cost the bench
+            print(
+                f"[bench] pipeline lane failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+
     # static op-budget ledger (r6): the planner's exact per-stage ALU
     # counts at the bench geometry ride in the BENCH json, and the
     # cost model's independent recount must agree within 5% — the
@@ -761,6 +949,15 @@ def main():
             file=sys.stderr,
         )
         sys.exit(2)
+    if pipeline_mismatch is not None:
+        print(
+            f"[bench] FATAL: pipeline overlap term drifted "
+            f"{pipeline_mismatch:.1%} from the shipped-plan recount "
+            "(or the pipelined run was not byte-identical) — see the "
+            "pipeline block above",
+            file=sys.stderr,
+        )
+        sys.exit(2)
     if _SCHEMA_ERRORS:
         print(
             f"[bench] FATAL: {len(_SCHEMA_ERRORS)} BENCH-record schema "
@@ -772,4 +969,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--pipeline-lane" in sys.argv:
+        # subprocess entrypoint for the CPU-fallback pipeline A/B (the
+        # parent's backend is frozen at 1 device); prints ONE json line
+        _i = sys.argv.index("--pipeline-lane")
+        print(json.dumps(pipeline_lane(int(sys.argv[_i + 1]))))
+    else:
+        main()

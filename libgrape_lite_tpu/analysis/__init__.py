@@ -1,7 +1,7 @@
 """grape-lint: static contract linter + compiled-artifact auditor.
 
 The compile-time complement to guard/ (which proves invariants at
-runtime): Layer 1 AST lints (R1-R5, analysis/astlint.py) make the bug
+runtime): Layer 1 AST lints (R1-R6, analysis/astlint.py) make the bug
 classes earlier review passes caught by hand un-shippable — baked
 closure constants, per-dispatch re-jits, incomplete cache keys, query
 entrypoints that skip the dyn stale-view check, eager hot-loop

@@ -84,6 +84,22 @@ RULES: Dict[str, Rule] = {
             "(bool is an int subclass)",
         ),
         Rule(
+            "R6", "pipeline-window-read",
+            "code between the exchange kickoff and the join point of a "
+            "pipelined superstep reads a query-carry key (or a carry "
+            "alias bound before the kickoff, or — position-"
+            "independently — inside a nested function capturing the "
+            "carry) that is not named in the worker pipeline contract "
+            "(parallel/pipeline.PIPELINE_WINDOW_READS), or passes the "
+            "whole carry dict to a callee not named in "
+            "PIPELINE_WINDOW_CALLEES",
+            "r9 (preventive): the double-buffered pipeline exists "
+            "because an in-flight exchange aliasing the live carry "
+            "reads torn state; every window read must be audited as "
+            "double-buffer-safe and named in the contract, so the "
+            "aliasing class is un-shippable instead of re-findable",
+        ),
+        Rule(
             "A1", "constant-bloat",
             "the lowered HLO of a fused runner holds a literal "
             "constant above the byte threshold — an R1 escape "

@@ -31,6 +31,8 @@ class BFS(ParallelAppBase):
     dyn_overlay_support = True
     inc_mode = "monotone-min"
     inc_seed_keys = {"depth": "min"}
+    # r9: unit-weight tropical relax — min folds split bit-stably
+    pipeline_state_key = "depth"
 
     def init_state(self, frag, source=0):
         import os
@@ -85,6 +87,22 @@ class BFS(ParallelAppBase):
                     warn_pack_ineligible("BFS", "no pack plan buildable")
                 else:
                     eph_entries.update(self._pack.state_entries())
+        # superstep pipelining (r9): after the exchange/SpMV decisions,
+        # which the pipelined round reuses verbatim (see SSSP)
+        self._pipeline = None
+        if not batched and not self._dyn:
+            from libgrape_lite_tpu.parallel.pipeline import resolve_pipeline
+
+            self._pipeline = resolve_pipeline(
+                frag, app_name="BFS", key="depth", direction="ie",
+                mirror=self._mx, mx_prefix="mx_", pack=self._pack,
+                fold="min", with_weights=False,
+            )
+            if self._pipeline is not None:
+                eph_entries.update(self._pipeline.host_entries)
+        self._pipeline_uid = (
+            self._pipeline.uid if self._pipeline is not None else -1
+        )
         if eph_entries:
             state.update(eph_entries)
             self.ephemeral_keys = frozenset(eph_entries)
@@ -137,6 +155,59 @@ class BFS(ParallelAppBase):
         changed = jnp.logical_and(new < depth, frag.inner_mask)
         active = ctx.sum(changed.sum().astype(jnp.int32))
         return {"depth": new}, active
+
+    def inceval_pipelined(self, ctx: StepContext, frag, state, xbuf):
+        """Double-buffered round (parallel/pipeline.py; see SSSP):
+        boundary relax, exchange kickoff, interior relax overlapping
+        the collective, join at the boundary mask — bit-identical to
+        the serial min relax."""
+        pl = self._pipeline
+        depth = state["depth"]
+        sent = jnp.int32(_SENTINEL)
+        full = pl.splice(ctx, depth, state, xbuf)
+        bmask = state["pl_bmask"]
+
+        def pack_relax(dispatch):
+            full_f = jnp.where(
+                full == sent, jnp.float32(jnp.inf),
+                full.astype(jnp.float32),
+            )
+            red = dispatch.reduce(full_f, state, "min") + 1.0
+            return jnp.where(
+                jnp.isfinite(red), red.astype(jnp.int32), sent
+            )
+
+        if pl.pack_b is not None:
+            rel_b = pack_relax(pl.pack_b)
+        else:
+            nb = full[state["pl_b_nbr"]]
+            cand_b = jnp.where(
+                jnp.logical_and(state["pl_b_val"], nb != sent),
+                nb + 1, sent,
+            )
+            rel_b = self.segment_reduce(
+                cand_b, state["pl_b_src"], frag.vp, "min"
+            )
+        new_b = jnp.minimum(depth, rel_b)
+        xbuf2 = pl.kickoff(ctx, jnp.where(bmask, new_b, depth), state)
+        # ---- pipelined window: carry reads below are named in
+        # parallel/pipeline.PIPELINE_WINDOW_READS (grape-lint R6) ----
+        if pl.pack_i is not None:
+            rel_i = pack_relax(pl.pack_i)
+        else:
+            ni = full[state["pl_i_nbr"]]
+            cand_i = jnp.where(
+                jnp.logical_and(state["pl_i_val"], ni != sent),
+                ni + 1, sent,
+            )
+            rel_i = self.segment_reduce(
+                cand_i, state["pl_i_src"], frag.vp, "min"
+            )
+        new_i = jnp.minimum(depth, rel_i)
+        new = jnp.where(bmask, new_b, new_i)
+        changed = jnp.logical_and(new < depth, frag.inner_mask)
+        active = ctx.sum(changed.sum().astype(jnp.int32))
+        return {"depth": new}, active, xbuf2
 
     def invariants(self, frag, state):
         # levels live in [0, SENTINEL] and only ever improve (pull-mode

@@ -31,6 +31,11 @@ class WCC(ParallelAppBase):
     dyn_overlay_support = True
     inc_mode = "monotone-min"
     inc_seed_keys = {"comp": "min"}
+    # r9: min-gid propagation pipelines on UNDIRECTED graphs (one pull
+    # per round); the directed form's oe pull reads the ie-folded
+    # labels mid-round — a second, dependent exchange that the
+    # double-buffered body cannot hide, so it stays serial
+    pipeline_state_key = "comp"
 
     def init_state(self, frag, **_):
         import os
@@ -98,6 +103,29 @@ class WCC(ParallelAppBase):
                     eph_entries.update(ie.state_entries())
                     if oe is not None:
                         eph_entries.update(oe.state_entries())
+        # superstep pipelining (r9): single-pull (undirected) form only
+        self._pipeline = None
+        if not self._dyn:
+            from libgrape_lite_tpu.parallel.pipeline import resolve_pipeline
+
+            self._pipeline = resolve_pipeline(
+                frag, app_name="WCC", key="comp", direction="ie",
+                mirror=self._mx_ie, mx_prefix="mx_ie_",
+                pack=self._pack_ie, fold="min", with_weights=False,
+                eligible=(
+                    not frag.directed
+                    and type(self)._post_pull is WCC._post_pull
+                ),
+                reason="directed WCC pulls oe against the ie-folded "
+                       "labels (dependent second exchange per round), "
+                       "and _post_pull overrides (WCCOpt pointer "
+                       "jumping) are unaudited for the split",
+            )
+            if self._pipeline is not None:
+                eph_entries.update(self._pipeline.host_entries)
+        self._pipeline_uid = (
+            self._pipeline.uid if self._pipeline is not None else -1
+        )
         if eph_entries:
             state.update(eph_entries)
             self.ephemeral_keys = frozenset(eph_entries)
@@ -162,6 +190,51 @@ class WCC(ParallelAppBase):
         changed = jnp.logical_and(new < comp, frag.inner_mask)
         active = ctx.sum(changed.sum().astype(jnp.int32))
         return {"comp": new}, active
+
+    def inceval_pipelined(self, ctx: StepContext, frag, state, xbuf):
+        """Double-buffered round (parallel/pipeline.py; see SSSP) for
+        the undirected single-pull form: boundary label fold, exchange
+        kickoff, interior fold under the in-flight collective, join —
+        bit-identical (min-gid is any-order exact)."""
+        pl = self._pipeline
+        comp = state["comp"]
+        big = jnp.int32(np.iinfo(np.int32).max)
+        full = pl.splice(ctx, comp, state, xbuf)
+        bmask = state["pl_bmask"]
+
+        def pack_fold(dispatch):
+            red = dispatch.reduce(full.astype(jnp.float32), state, "min")
+            return jnp.where(
+                jnp.isfinite(red), red.astype(jnp.int32), big
+            )
+
+        if pl.pack_b is not None:
+            rel_b = pack_fold(pl.pack_b)
+        else:
+            cand_b = jnp.where(
+                state["pl_b_val"], full[state["pl_b_nbr"]], big
+            )
+            rel_b = self.segment_reduce(
+                cand_b, state["pl_b_src"], frag.vp, "min"
+            )
+        new_b = jnp.minimum(comp, rel_b)
+        xbuf2 = pl.kickoff(ctx, jnp.where(bmask, new_b, comp), state)
+        # ---- pipelined window: carry reads below are named in
+        # parallel/pipeline.PIPELINE_WINDOW_READS (grape-lint R6) ----
+        if pl.pack_i is not None:
+            rel_i = pack_fold(pl.pack_i)
+        else:
+            cand_i = jnp.where(
+                state["pl_i_val"], full[state["pl_i_nbr"]], big
+            )
+            rel_i = self.segment_reduce(
+                cand_i, state["pl_i_src"], frag.vp, "min"
+            )
+        new_i = jnp.minimum(comp, rel_i)
+        new = jnp.where(bmask, new_b, new_i)
+        changed = jnp.logical_and(new < comp, frag.inner_mask)
+        active = ctx.sum(changed.sum().astype(jnp.int32))
+        return {"comp": new}, active, xbuf2
 
     def inc_value_map(self, key, values, old_frag, new_frag):
         """Component labels are PIDS, so a repack (which renumbers the

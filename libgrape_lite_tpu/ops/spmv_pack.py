@@ -2275,10 +2275,17 @@ def _frag_cache(frag):
 
 
 def _shard_edges(frag, fid: int, with_weights: bool, direction: str,
-                 cols_override=None):
+                 cols_override=None, row_mask=None):
     csrs = frag.host_ie if direction == "ie" else frag.host_oe
     h = csrs[fid] if csrs else (frag.host_oe[fid])
     mask = h.edge_mask
+    if row_mask is not None:
+        # boundary/interior sub-plan (superstep pipelining, r9): keep
+        # only edges whose destination row is in this partition — the
+        # original CSR order is preserved, so each surviving row's
+        # fold sees its candidates in the serial order
+        safe_src = np.minimum(h.edge_src.astype(np.int64), frag.vp - 1)
+        mask = np.logical_and(mask, np.asarray(row_mask[fid])[safe_src])
     rows = h.edge_src[mask].astype(np.int64)
     if cols_override is not None:
         cols = np.asarray(cols_override[fid])[mask].astype(np.int64)
@@ -2426,15 +2433,34 @@ PLAN_STATS = {"frag_cache_hits": 0, "disk_cache_hits": 0, "planned": 0}
 
 
 def plan_stats() -> dict:
-    """Snapshot of the resolve-path counters (copy — mutation-safe)."""
-    return dict(PLAN_STATS)
+    """Snapshot of the resolve-path counters (copy — mutation-safe).
+    When a superstep pipeline has been resolved (GRAPE_PIPELINE,
+    parallel/pipeline.py), the snapshot additionally carries its
+    boundary/interior vertex+edge counts per fragment under
+    "pipeline" — the boundary-set stats surfaced everywhere the plan
+    is (Worker.pack_ledger, trace_report)."""
+    out = dict(PLAN_STATS)
+    try:
+        from libgrape_lite_tpu.parallel.pipeline import PIPELINE_STATS
+
+        if PIPELINE_STATS["last_stats"] is not None:
+            out["pipeline"] = {
+                "resolved": PIPELINE_STATS["resolved"],
+                "declined": PIPELINE_STATS["declined"],
+                **PIPELINE_STATS["last_stats"],
+            }
+    except ImportError:  # pragma: no cover — circular-import safety
+        pass
+    return out
 
 
 def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
                           with_weights: bool = False,
                           direction: str = "ie",
                           prefix: str = "pk_",
-                          mirror=None):
+                          mirror=None,
+                          role: str = "full",
+                          row_mask=None):
     """Resolve the pack backend for `frag`: a PackDispatch, or None if
     no plan is buildable (caller should warn_pack_ineligible).  Checks
     the persistent plan cache (GRAPE_PACK_PLAN_CACHE) before running
@@ -2443,11 +2469,20 @@ def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
     `mirror` (a parallel.mirror.MirrorPlan for the same direction)
     composes the plan with the mirror-compressed exchange: columns are
     the compact remapped ones and the gather table covers only
-    vp + fnum*m entries instead of fnum*vp."""
+    vp + fnum*m entries instead of fnum*vp.
+
+    `role`/`row_mask` (superstep pipelining, r9): "boundary" /
+    "interior" sub-plans cover only edges whose destination row is in
+    `row_mask` [fnum, vp], so the SpMV can run the boundary slice
+    first and overlap the exchange with the interior slice.  The role
+    is part of BOTH the per-fragment cache key and the v3 plan-cache
+    digest — the disk cache must never serve a serial (full) plan to
+    a pipelined run or vice versa, even if a future filter made their
+    edge streams collide."""
     cfg = cfg or PackConfig.from_env()
     per_frag = _frag_cache(frag)
     key = (cfg, with_weights, direction, "dispatch",
-           mirror.uid if mirror is not None else 0, _scan_mode())
+           mirror.uid if mirror is not None else 0, _scan_mode(), role)
     if key in per_frag:
         mplan = per_frag[key]
         PLAN_STATS["frag_cache_hits"] += 1
@@ -2460,17 +2495,21 @@ def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
     shards = []
     for f in range(frag.fnum):
         shard = _shard_edges(frag, f, with_weights, direction,
-                             cols_override)
+                             cols_override, row_mask)
         if shard is None:
             return None
         shards.append(shard)
 
-    mplan = _load_cached_mplan(shards, frag.vp, n_cols, cfg)
+    mplan = _load_cached_mplan(shards, frag.vp, n_cols, cfg, role)
     if mplan is not None:
         PLAN_STATS["disk_cache_hits"] += 1
     else:
         PLAN_STATS["planned"] += 1
-        if mirror is not None:
+        if row_mask is not None:
+            # sub-plans always take the multi planner (uniform
+            # skeleton over the filtered per-shard streams)
+            mplan = plan_pack_multi(shards, frag.vp, n_cols, cfg)
+        elif mirror is not None:
             mplan = plan_pack_multi(shards, frag.vp, n_cols, cfg)
         elif frag.fnum == 1:
             plan = plan_pack_for_fragment(frag, cfg, with_weights,
@@ -2483,7 +2522,7 @@ def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
                                                  direction)
             if mplan is None:
                 return None
-        _save_cached_mplan(mplan, shards)
+        _save_cached_mplan(mplan, shards, role)
     per_frag[key] = mplan
     return PackDispatch(
         mplan, "const" if frag.fnum == 1 else "state", prefix
@@ -2514,14 +2553,18 @@ _STREAM_DTYPES = {
 }
 
 
-def _shards_digest(shards, vp: int, n_cols: int, cfg: PackConfig) -> str:
+def _shards_digest(shards, vp: int, n_cols: int, cfg: PackConfig,
+                   role: str = "full") -> str:
     """Content key for cached plans.  The config prefix fingerprints
     the FULL PackConfig (every dataclass field, so a future knob can't
     silently alias two configs), the input stream dtypes, the shipped
     stream dtype table, the schema version and the planner modes —
     including GRAPE_PACK_SCAN, so a scan-mode flip invalidates stale
     cached plans instead of loading ones whose shipped planes belong
-    to the other kernel family."""
+    to the other kernel family, and the pipeline `role`
+    (full/boundary/interior), so the cache can never hand a serial
+    plan to a pipelined run even if the filtered edge streams were to
+    coincide (r9; the threshold decision IS the role)."""
     import dataclasses
     import hashlib
 
@@ -2533,6 +2576,7 @@ def _shards_digest(shards, vp: int, n_cols: int, cfg: PackConfig) -> str:
         "final_tile_sub": _FINAL_TILE_SUB,
         "compose": _compose_enabled(),
         "scan": _scan_mode(),
+        "role": role,
         "stream_dtypes": _STREAM_DTYPES,
         "vp": vp,
         "n_cols": n_cols,
@@ -2553,23 +2597,25 @@ def _shards_digest(shards, vp: int, n_cols: int, cfg: PackConfig) -> str:
     return h.hexdigest()[:24]
 
 
-def _plan_cache_path(shards, vp, n_cols, cfg):
+def _plan_cache_path(shards, vp, n_cols, cfg, role: str = "full"):
     import os
 
     root = os.environ.get("GRAPE_PACK_PLAN_CACHE")
     if not root:
         return None
     return os.path.join(
-        root, f"packplan_{_shards_digest(shards, vp, n_cols, cfg)}.npz"
+        root,
+        f"packplan_{_shards_digest(shards, vp, n_cols, cfg, role)}.npz",
     )
 
 
-def _save_cached_mplan(mplan: MultiPackPlan, shards):
+def _save_cached_mplan(mplan: MultiPackPlan, shards, role: str = "full"):
     import dataclasses
     import json
     import os
 
-    path = _plan_cache_path(shards, mplan.vp, mplan.n_cols, mplan.cfg)
+    path = _plan_cache_path(shards, mplan.vp, mplan.n_cols, mplan.cfg,
+                            role)
     if path is None:
         return
     os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -2593,11 +2639,11 @@ def _save_cached_mplan(mplan: MultiPackPlan, shards):
     os.replace(tmp, path)
 
 
-def _load_cached_mplan(shards, vp, n_cols, cfg):
+def _load_cached_mplan(shards, vp, n_cols, cfg, role: str = "full"):
     import json
     import os
 
-    path = _plan_cache_path(shards, vp, n_cols, cfg)
+    path = _plan_cache_path(shards, vp, n_cols, cfg, role)
     if path is None or not os.path.exists(path):
         return None
     try:

@@ -41,6 +41,41 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def exchange_bytes_ledger(fnum: int, vp: int, m: int | None = None,
+                          itemsize: int = 4) -> dict:
+    """THE per-round exchange-bytes model, in one place.
+
+    Both consumers read this function — `resolve_mirror_plan`'s
+    auto-mode engagement gate AND the superstep-pipelining threshold
+    (parallel/pipeline.py) — instead of keeping private copies of
+    "exchange bytes" that could drift apart (the r9 bugfix: the auto
+    gate used to count only the all_gather it replaces, inline).
+
+    Returns {"gather": per-device ICI bytes of the full-state
+    all_gather, "mirror": bytes of the mirror all_to_all (None when no
+    mirror plan exists/fits)}."""
+    return {
+        "gather": fnum * vp * itemsize,
+        "mirror": None if m is None else fnum * m * itemsize,
+    }
+
+
+def pipelined_round_s(compute_interior_s: float, exchange_s: float,
+                      compute_boundary_s: float) -> float:
+    """The software-pipelined round's modeled wall time:
+
+        t = max(compute_interior, exchange) + compute_boundary
+
+    — the exchange for round k+1 overlaps round k's interior slice
+    and joins at the fold; only the boundary slice (which produces the
+    exchange payload) stays on the critical path.  MAX, not SUM: under
+    pipelining, shrinking the exchange below the interior-compute time
+    buys nothing, which is why the mirror auto-mode decision and the
+    pipeline engagement threshold must share this one model
+    (docs/PIPELINE.md)."""
+    return max(compute_interior_s, exchange_s) + compute_boundary_s
+
+
 @dataclass
 class MirrorPlan:
     """Static routing for the mirror exchange of one fragment+direction."""
@@ -56,13 +91,14 @@ class MirrorPlan:
     @property
     def bytes_all_gather(self) -> int:
         """Per-device ICI bytes per round of the full-state all_gather
-        this plan replaces (f32 payload)."""
-        return self.fnum * self.vp * 4
+        this plan replaces (f32 payload; shared ledger —
+        exchange_bytes_ledger)."""
+        return exchange_bytes_ledger(self.fnum, self.vp, self.m)["gather"]
 
     @property
     def bytes_mirror(self) -> int:
         """Per-device ICI bytes per round of the mirror all_to_all."""
-        return self.fnum * self.m * 4
+        return exchange_bytes_ledger(self.fnum, self.vp, self.m)["mirror"]
 
     def state_entries(self, prefix: str) -> dict:
         """Ephemeral state leaves ([fnum, ...], sharded on dim 0)."""
@@ -108,7 +144,8 @@ def resolve_mirror_plan(frag, direction: str = "ie"):
         return None
     if mode in ("gather", "off") or frag.fnum == 1:
         return None
-    if mode != "mirror" and frag.fnum * frag.vp * 4 <= _AUTO_MIN_BYTES:
+    gather_bytes = exchange_bytes_ledger(frag.fnum, frag.vp)["gather"]
+    if mode != "mirror" and gather_bytes <= _AUTO_MIN_BYTES:
         return None  # too small for bytes to matter; skip the planner
     plan = build_mirror_plan(frag, direction)
     if plan is None or mode == "mirror":

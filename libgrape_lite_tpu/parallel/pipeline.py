@@ -1,0 +1,461 @@
+"""Superstep software pipelining — overlap the halo exchange with
+interior compute (ROADMAP item 3, r9).
+
+The fused superstep runs compute -> exchange strictly serially, so
+every round the VPU idles for the full `all_gather`/`all_to_all` (obs/
+measures it as the dispatch/device split; SparseP frames the same
+compute/transfer balance).  This module restructures the round as a
+double-buffered software pipeline over the boundary/interior vertex
+split of `fragment/edgecut.boundary_split`:
+
+  round k:   compute BOUNDARY slice   (reads the buffered exchange xbuf)
+             kick off the exchange    (round k+1's inputs — only the
+                                       boundary rows just computed)
+             compute INTERIOR slice   (overlaps the in-flight collective)
+             join at the fold         (per-row select on the boundary mask)
+
+Byte-identity argument (the pinned contract, tests/test_pipeline.py):
+
+  * every REMOTE read of fragment g's state touches only g's boundary
+    rows (that is the definition of boundary), and the kickoff payload
+    carries exactly those rows' NEW values;
+  * every LOCAL read goes through `splice`, which overlays the live
+    local block over the buffered table — bitwise the serial value;
+  * the boundary and interior slices partition the output rows, and
+    each row's fold consumes exactly its own edges in their original
+    CSR order — so the joined state equals the serial state bit for
+    bit, inductively over rounds.
+
+The exchange buffer `xbuf` is an INTERNAL while-loop carry: it is
+created after PEval and dropped at loop exit, and it is a pure
+function of the query carry (the exchange of the current state).  The
+observable cut therefore never moves: guard digests, checkpoint
+snapshots and watchdog residuals all observe the post-join carry —
+the same consistent cut as the serial loop (docs/PIPELINE.md).
+
+Engagement (`GRAPE_PIPELINE`):
+
+  * unset / "0" / ""  — off: the serial loop body compiles bit-for-bit
+    unchanged (lowered-HLO pinned);
+  * "1" / "auto"      — engage when the modeled per-round exchange
+    bytes (`mirror.exchange_bytes_ledger` — the SAME ledger the
+    mirror auto mode reads) clear GRAPE_PIPELINE_MIN_BYTES (default
+    1 MiB): latency-bound exchanges lose to the extra dispatch, the
+    `_AUTO_MIN_BYTES` discipline;
+  * "force"           — engage whenever structurally possible (tests,
+    small-graph A/Bs).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from libgrape_lite_tpu.parallel.mirror import (
+    exchange_bytes_ledger,
+    pipelined_round_s,
+)
+
+# auto-mode engagement floor, same discipline (and the same shared
+# byte ledger) as mirror._AUTO_MIN_BYTES: below ~1 MiB the exchange is
+# collective-latency-bound and the split's extra dispatch loses
+_MIN_BYTES_DEFAULT = 1 << 20
+
+# ---- the worker pipeline contract (grape-lint R6) -------------------------
+#
+# Inside the pipelined window — between the exchange kickoff and the
+# join — the ONLY reads of the query carry (or of ephemeral streams
+# standing in for it) permitted by grape-lint rule R6 are the names
+# below.  Entries ending in "*" are prefixes.  Every name here is an
+# AUDITED read: it is safe precisely because the kickoff writes into a
+# fresh double buffer and never aliases the live carry — the aliasing
+# bug class this contract fossilizes.  Adding a read to the window
+# means auditing it and naming it here, in review.
+PIPELINE_WINDOW_READS = frozenset({
+    # live carry leaves the interior slice folds against
+    "dist", "depth", "comp", "rank",
+    # PageRank's replicated scalars (read by the joined round_update)
+    "step", "seed", "dangling_sum", "total_dangling",
+    # the boundary mask (the join selector) and the interior streams
+    "pl_bmask", "pl_i_src", "pl_i_nbr", "pl_i_val", "pl_i_w",
+    # interior pack sub-plan streams (read inside PackDispatch.reduce)
+    "pki_*",
+})
+
+# Callees AUDITED to receive the whole carry dict inside the window.
+# R6 cannot see into another module's function body, so passing the
+# full `state` to an un-named callee after the kickoff is flagged as a
+# whole-carry escape; each name here was audited by hand:
+#   reduce        PackDispatch.reduce — reads only its own pk*_ stream
+#                 leaves (pki_*/pkb_ prefixes) plus the table argument
+#   round_update  PageRank — reads the replicated scalar keys named in
+#                 PIPELINE_WINDOW_READS above, elementwise per row
+PIPELINE_WINDOW_CALLEES = frozenset({"reduce", "round_update"})
+
+# resolve-path registry: the last pipeline decision + split stats, so
+# plan_stats()/trace_report can surface boundary-set sizes without
+# holding fragment references
+PIPELINE_STATS = {
+    "resolved": 0,        # plans built (engaged)
+    "declined": 0,        # structurally eligible but below threshold/off
+    "last_decision": None,
+    "last_stats": None,
+}
+
+
+def pipeline_mode() -> str:
+    """off | auto | force, from GRAPE_PIPELINE (default off: the
+    serial superstep stays the compiled program until an A/B on real
+    hardware flips the default — docs/PIPELINE.md)."""
+    v = os.environ.get("GRAPE_PIPELINE", "") or "0"
+    if v in ("0", "", "off"):
+        return "off"
+    if v == "force":
+        return "force"
+    return "auto"  # "1", "auto", anything else truthy
+
+
+def pipeline_min_bytes() -> int:
+    v = os.environ.get("GRAPE_PIPELINE_MIN_BYTES", "")
+    return int(v) if v else _MIN_BYTES_DEFAULT
+
+
+# modeled rates for the overlap term (same explicit-assumption style
+# as scripts/pack_cost_model.py, which recounts this model from the
+# shipped arrays and gates on >5% drift)
+VPU_LANES_PER_CYCLE = 1024      # one (8,128) vreg op per cycle
+CLOCK_HZ = 940e6                # v5e core clock
+ICI_BPS = 9e10                  # ~2x45 GB/s v5e ICI links, per device
+DEFAULT_OPS_PER_EDGE = 30.0     # XLA gather+segment fold, no pack ledger
+
+
+def overlap_model(boundary_edges: int, interior_edges: int,
+                  exchange_bytes: int,
+                  ops_per_edge: float | None = None) -> dict:
+    """The exchange-overlap term of the op-budget ledger:
+
+        t_serial    = compute_b + compute_i + exchange
+        t_pipelined = max(compute_i, exchange) + compute_b
+
+    (`mirror.pipelined_round_s` — max not sum).  Returns modeled round
+    times plus `hidden_frac`, the fraction of the exchange hidden
+    under interior compute (min(compute_i, exchange) / exchange) —
+    the number the bench `pipeline` block and the obs query span
+    report, and trace_report flags when it lands under 10%."""
+    ope = DEFAULT_OPS_PER_EDGE if ops_per_edge is None else ops_per_edge
+    rate = VPU_LANES_PER_CYCLE * CLOCK_HZ
+    t_b = boundary_edges * ope / rate
+    t_i = interior_edges * ope / rate
+    t_x = exchange_bytes / ICI_BPS
+    t_serial = t_b + t_i + t_x
+    t_pipe = pipelined_round_s(t_i, t_x, t_b)
+    hidden = min(t_i, t_x) / t_x if t_x > 0 else 0.0
+    return {
+        "t_serial_s": t_serial,
+        "t_pipelined_s": t_pipe,
+        "hidden_frac": round(hidden, 4),
+        "round_speedup": round(t_serial / t_pipe, 4) if t_pipe > 0 else 1.0,
+        "exchange_s": t_x,
+        "compute_boundary_s": t_b,
+        "compute_interior_s": t_i,
+    }
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclass
+class PipelinePlan:
+    """One resolved boundary/interior pipeline for an app's pull.
+
+    Host side: the split edge streams (or pack sub-dispatches) ride as
+    ephemeral state leaves — `host_entries` merges into the app's init
+    state exactly like mirror/pack tables (closure capture would trip
+    grape-lint R1 and replicate under shard_map).  Traced side:
+    `exchange`/`kickoff`/`splice` are the three collective touchpoints
+    of the pipelined round (see module docstring)."""
+
+    mode: str                  # "mirror" | "gather"
+    key: str                   # the exchanged carry leaf ("dist", ...)
+    fnum: int
+    vp: int
+    m: int                     # mirror slots (0 in gather mode)
+    send_key: str              # state key of the mirror send table
+    prefix: str = "pl_"
+    pack_b: Optional[object] = None   # boundary PackDispatch
+    pack_i: Optional[object] = None   # interior PackDispatch
+    stats: dict = field(default_factory=dict)
+    exchange_bytes: int = 0
+    decision: dict = field(default_factory=dict)
+    host_entries: dict = field(default_factory=dict)
+    ops_per_edge: Optional[float] = None
+
+    @property
+    def uid(self) -> str:
+        """STABLE content fingerprint of the compiled-trace-relevant
+        plan shape — this rides in the app's `trace_key` (as
+        `_pipeline_uid`) to keep serial and pipelined compiles in
+        separate runner-cache entries.  It must be identical across
+        re-resolves of the same plan: a per-resolve counter here made
+        every query recompile (trace_key changed each init_state),
+        which turned the bench A/B into a compile-time measurement.
+        Stream SHAPES (split sizes, sub-plan skeletons) already key
+        the runner cache via the state struct; this only needs the
+        routing facts the struct cannot see."""
+        return (
+            f"{self.mode}:{self.fnum}:{self.vp}:{self.m}:"
+            f"{'pack' if self.pack_b is not None else 'xla'}"
+        )
+
+    # ---- traced (inside shard_map) ----
+
+    def exchange(self, ctx, x_local, state):
+        """The halo exchange of `x_local`'s read rows — bitwise the
+        payload of the serial round's exchange when the boundary rows
+        of `x_local` are current (pad/interior rows are never read
+        remotely).  Routed through the SAME StepContext collectives
+        the serial round uses (one copy of the exchange wiring); the
+        mirror form drops the helper's leading live-local block — the
+        buffer must hold only remote rows, `splice` re-attaches the
+        LIVE local block at read time."""
+        if self.mode == "mirror":
+            compact = ctx.exchange_mirrors(
+                x_local, state[self.send_key]
+            )
+            return compact[self.vp:]
+        return ctx.gather_state(x_local)
+
+    def kickoff(self, ctx, x_kick, state):
+        """Kick off round k+1's exchange from the boundary-merged
+        carry (new values at boundary rows, stale elsewhere — the
+        stale rows are never read).  Distinct name on purpose: this
+        call opens the pipelined window grape-lint R6 audits."""
+        return self.exchange(ctx, x_kick, state)
+
+    def splice(self, ctx, x_local, state, xbuf):
+        """The full pull table for this round: LIVE local rows overlaid
+        on the buffered remote rows — local reads are bitwise the
+        serial value, remote reads hit only (current) boundary rows."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        if self.mode == "mirror":
+            return jnp.concatenate([x_local, xbuf])
+        fid = ctx.fid()
+        return lax.dynamic_update_slice(xbuf, x_local, (fid * self.vp,))
+
+    # ---- host side ----
+
+    def span_brief(self) -> dict:
+        """The obs query-span attachment (and the bench `pipeline`
+        block's modeled half)."""
+        t = self.stats.get("totals", {})
+        model = overlap_model(
+            t.get("boundary_edges", 0), t.get("interior_edges", 0),
+            self.exchange_bytes, self.ops_per_edge,
+        )
+        return {
+            "engaged": True,
+            "mode": self.mode,
+            "exchange_bytes": self.exchange_bytes,
+            "modeled_hidden_frac": model["hidden_frac"],
+            "hidden_us_per_round": self.hidden_us_per_round(),
+            "boundary_vertices": t.get("boundary_vertices", 0),
+            "interior_vertices": t.get("interior_vertices", 0),
+            "boundary_edges": t.get("boundary_edges", 0),
+            "interior_edges": t.get("interior_edges", 0),
+        }
+
+    def hidden_us_per_round(self) -> float:
+        """Modeled exchange time hidden under interior compute, per
+        superstep, in µs: min(compute_interior, exchange).  The obs
+        query span records `overlap_hidden_us` = this x rounds, and
+        trace_report's overlap column prints it per superstep with a
+        drift flag when the plan is armed but hides <10% of the
+        exchange."""
+        t = self.stats.get("totals", {})
+        model = overlap_model(
+            t.get("boundary_edges", 0), t.get("interior_edges", 0),
+            self.exchange_bytes, self.ops_per_edge,
+        )
+        return round(
+            min(model["compute_interior_s"], model["exchange_s"]) * 1e6,
+            3,
+        )
+
+
+def _split_streams(frag, bmask: np.ndarray, direction: str, mirror,
+                   with_weights: bool, prefix: str) -> dict:
+    """Stable row-partitioned edge streams for the XLA fold path.
+
+    Per part (b = boundary rows, i = interior rows) and per fragment:
+    `src` (pad -> vp overflow row), `nbr` (compact columns under a
+    mirror plan, pids otherwise; pad -> 0), `val` (validity), and `w`
+    when weighted — each padded to the per-part max across shards
+    (one traced program under shard_map).  Within a part the original
+    CSR edge order is preserved, so every row's fold consumes its own
+    candidates in the serial order (the byte-identity invariant; for
+    float sums this additionally relies on XLA's order-deterministic
+    sorted segment reduction, pinned by tests/test_pipeline.py)."""
+    fnum, vp = frag.fnum, frag.vp
+    csrs = frag.host_ie if direction == "ie" else frag.host_oe
+    parts = {"b": [], "i": []}
+    for f in range(fnum):
+        h = csrs[f]
+        mask = h.edge_mask
+        src = h.edge_src.astype(np.int64)
+        cols = (
+            mirror.nbr_compact[f] if mirror is not None else h.edge_nbr
+        ).astype(np.int64)
+        safe_src = np.minimum(src, vp - 1)
+        is_b = np.logical_and(mask, bmask[f][safe_src])
+        is_i = np.logical_and(mask, ~bmask[f][safe_src])
+        for part, sel in (("b", is_b), ("i", is_i)):
+            idx = np.flatnonzero(sel)
+            parts[part].append((
+                src[idx].astype(np.int32),
+                cols[idx].astype(np.int32),
+                None if not with_weights else h.edge_w[idx],
+            ))
+    out = {prefix + "bmask": bmask}
+    for part, shards in parts.items():
+        cap = _round_up(max([len(s[0]) for s in shards] + [1]), 128)
+        src_a = np.full((fnum, cap), vp, dtype=np.int32)
+        nbr_a = np.zeros((fnum, cap), dtype=np.int32)
+        val_a = np.zeros((fnum, cap), dtype=bool)
+        w_a = (
+            np.zeros((fnum, cap), dtype=csrs[0].edge_w.dtype)
+            if with_weights else None
+        )
+        for f, (src, nbr, w) in enumerate(shards):
+            n = len(src)
+            src_a[f, :n] = src
+            nbr_a[f, :n] = nbr
+            val_a[f, :n] = True
+            if w_a is not None:
+                w_a[f, :n] = w
+        p = f"{prefix}{part}_"
+        out[p + "src"] = src_a
+        out[p + "nbr"] = nbr_a
+        out[p + "val"] = val_a
+        if w_a is not None:
+            out[p + "w"] = w_a
+    return out
+
+
+def resolve_pipeline(frag, *, app_name: str, key: str,
+                     direction: str = "ie", mirror=None,
+                     mx_prefix: str = "mx_", pack=None,
+                     fold: str = "min", with_weights: bool = False,
+                     eligible: bool = True, reason: str = ""):
+    """Resolve the superstep pipeline for one app's pull, or None.
+
+    `mirror`/`pack` are the app's ALREADY-RESOLVED exchange and SpMV
+    backends — the pipelined round must use the same exchange mode and
+    the same fold machinery as the serial one, or byte-identity is
+    off the table.  Decline reasons are recorded in
+    PIPELINE_STATS["last_decision"] (and vlogged), never silent."""
+    from libgrape_lite_tpu.utils import logging as glog
+
+    mode = pipeline_mode()
+    decision = {"app": app_name, "mode": mode, "engaged": False}
+
+    def declined(why: str, count: bool = True):
+        decision["reason"] = why
+        PIPELINE_STATS["last_decision"] = decision
+        if count:
+            PIPELINE_STATS["declined"] += 1
+            glog.vlog(1, "pipeline: declined for %s: %s", app_name, why)
+        return None
+
+    if mode == "off":
+        return declined("GRAPE_PIPELINE off", count=False)
+    if not eligible:
+        return declined(reason or "app declared ineligible")
+    if frag.fnum <= 1:
+        return declined("fnum==1: no exchange to overlap")
+    ov = getattr(frag, "dyn_overlay", None)
+    if ov is not None:
+        return declined("dyn overlay attached (pid-addressed reads)")
+    if fold == "sum" and pack is not None:
+        # split pack sub-plans regroup float partial sums — exact for
+        # min/max folds, only allclose for sums (the documented pack
+        # float-parity limit); byte-identity wins
+        return declined("sum fold over the pack backend is not "
+                        "bit-stable under a split plan")
+
+    xmode = "mirror" if mirror is not None else "gather"
+    bytes_ledger = exchange_bytes_ledger(
+        frag.fnum, frag.vp, mirror.m if mirror is not None else None
+    )
+    xbytes = bytes_ledger[xmode] or 0
+    decision["exchange_bytes"] = xbytes
+    decision["min_bytes"] = pipeline_min_bytes()
+    if mode == "auto" and xbytes < pipeline_min_bytes():
+        return declined(
+            f"modeled exchange bytes {xbytes} below threshold "
+            f"{pipeline_min_bytes()} (latency-bound; set "
+            "GRAPE_PIPELINE_MIN_BYTES or =force to override)"
+        )
+
+    from libgrape_lite_tpu.fragment.edgecut import (
+        boundary_split, boundary_stats,
+    )
+
+    bmask = boundary_split(frag, (direction,))
+    stats = boundary_stats(frag, bmask, direction)
+
+    pack_b = pack_i = None
+    host_entries = {}
+    ops_per_edge = None
+    if pack is not None:
+        from libgrape_lite_tpu.ops.spmv_pack import resolve_pack_dispatch
+
+        inner = frag.host_inner_mask()
+        pack_b = resolve_pack_dispatch(
+            frag, direction=direction, prefix="pkb_", mirror=mirror,
+            with_weights=with_weights, role="boundary", row_mask=bmask,
+        )
+        pack_i = resolve_pack_dispatch(
+            frag, direction=direction, prefix="pki_", mirror=mirror,
+            with_weights=with_weights, role="interior",
+            row_mask=np.logical_and(inner, ~bmask),
+        )
+        if pack_b is None or pack_i is None:
+            return declined("pack split sub-plans not buildable "
+                            "(empty partition?)")
+        led = pack.ledger()
+        if led and led.get("edges"):
+            ops_per_edge = led["totals"]["vpu_ops"] / led["edges"]
+        host_entries.update(pack_b.state_entries())
+        host_entries.update(pack_i.state_entries())
+        host_entries["pl_bmask"] = bmask
+    else:
+        host_entries.update(_split_streams(
+            frag, bmask, direction, mirror, with_weights, "pl_"
+        ))
+
+    decision["engaged"] = True
+    plan = PipelinePlan(
+        mode=xmode, key=key, fnum=frag.fnum, vp=frag.vp,
+        m=mirror.m if mirror is not None else 0,
+        send_key=mx_prefix + "send",
+        pack_b=pack_b, pack_i=pack_i,
+        stats=stats, exchange_bytes=xbytes, decision=decision,
+        host_entries=host_entries, ops_per_edge=ops_per_edge,
+    )
+    PIPELINE_STATS["resolved"] += 1
+    PIPELINE_STATS["last_decision"] = decision
+    PIPELINE_STATS["last_stats"] = stats
+    glog.vlog(
+        1, "pipeline: engaged for %s (%s exchange, %d B/round, "
+        "%d boundary / %d interior vertices)",
+        app_name, xmode, xbytes,
+        stats["totals"].get("boundary_vertices", 0),
+        stats["totals"].get("interior_vertices", 0),
+    )
+    return plan

@@ -60,6 +60,48 @@ def _squeeze_lane_state(state, squeezed):
     }
 
 
+def _jit_with_chunk_digest(sm, state, eph):
+    """Wrap a compiled guarded-chunk shard_map so the watchdog digest
+    (and the stagnation residual) ride out as extra outputs of the
+    SAME jitted dispatch — computed on the global post-collective
+    carry, so they are value-identical to the monitor's own probe
+    (same carry_digest function, same masked-residual rule) and the
+    guarded-fused path pays no extra device dispatch for them (ROADMAP
+    "Watchdog on device").  ONE wrapper shared by the serial and the
+    software-pipelined chunk runners: the digest/residual contract is
+    a consistent-cut guarantee (docs/PIPELINE.md), and two private
+    copies of it could drift apart."""
+    from libgrape_lite_tpu.guard.watchdog import carry_digest
+
+    float_keys = sorted(
+        k for k, v in state.items()
+        if k not in eph and np.dtype(v.dtype).kind == "f"
+    )
+
+    def with_digest(frag_stacked, st, eph_state, active0, r0):
+        out, rounds, active = sm(
+            frag_stacked, st, eph_state, active0, r0
+        )
+        dig = carry_digest(out)
+        if float_keys:
+            diffs = [
+                jnp.max(jnp.where(
+                    jnp.isfinite(d), d, jnp.float32(0)
+                ))
+                for k in float_keys
+                for d in [jnp.abs(
+                    out[k].astype(jnp.float32)
+                    - st[k].astype(jnp.float32)
+                )]
+            ]
+            res = jnp.max(jnp.stack(diffs))
+        else:
+            res = jnp.float32(-1)
+        return out, rounds, active, dig, res
+
+    return jax.jit(with_digest)
+
+
 def _unsqueeze_lane_state(state, squeezed):
     return {
         k: (v[:, None] if k in squeezed else v) for k, v in state.items()
@@ -244,6 +286,67 @@ class Worker:
 
         return compile_for
 
+    def _make_pipelined_runner(self, max_rounds: int):
+        """Software-pipelined twin of `_make_runner` (r9, parallel/
+        pipeline.py): the loop carry additionally threads the exchange
+        double buffer `xbuf` — created from the post-PEval carry at
+        loop entry, advanced by each round's kickoff, DROPPED at exit.
+        The jitted interface (and therefore the observable cut: the
+        carry the caller, guard digests and checkpoints see) is
+        identical to the serial runner's; `xbuf` is a pure function of
+        the carry, so dropping and re-deriving it is bitwise free.
+        Only reached when the app resolved `_pipeline`; with
+        GRAPE_PIPELINE off `_runner_for` routes to `_make_runner`,
+        whose trace is bit-for-bit untouched (lowered-HLO pinned)."""
+        app = self.app
+        mesh, frag_spec = self._mesh_layout()
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+
+        def stepper(frag_stacked, state, eph_state, squeezed):
+            frag = frag_stacked.local()
+            st_all = _squeeze_state({**state, **eph_state}, squeezed)
+            eph_vals = {k: st_all[k] for k in eph}
+
+            def strip(s):
+                return {k: v for k, v in s.items() if k not in eph}
+
+            ctx = StepContext()
+            st, active = app.peval(ctx, frag, st_all)
+            st = strip(st)
+            xbuf = app.pipeline_exchange(ctx, frag, {**st, **eph_vals})
+            limit = jnp.int32(max_rounds if max_rounds > 0 else _INT32_MAX)
+
+            def cond(carry):
+                _, _, act, r = carry
+                return jnp.logical_and(act > 0, r < limit)
+
+            def body(carry):
+                s, xb, _, r = carry
+                s2, a2, xb2 = app.inceval_pipelined(
+                    ctx, frag, {**s, **eph_vals}, xb
+                )
+                return strip(s2), xb2, jnp.int32(a2), r + jnp.int32(1)
+
+            st, _, active, rounds = lax.while_loop(
+                cond, body, (st, xbuf, jnp.int32(active), jnp.int32(0))
+            )
+            return _unsqueeze_state(st, squeezed), rounds, active
+
+        def compile_for(state):
+            specs, squeezed = self._key_specs(state)
+            carry_specs = {k: v for k, v in specs.items() if k not in eph}
+            eph_specs = {k: v for k, v in specs.items() if k in eph}
+            sm = compat.shard_map(
+                partial(stepper, squeezed=squeezed),
+                mesh=mesh,
+                in_specs=(frag_spec, carry_specs, eph_specs),
+                out_specs=(carry_specs, P(), P()),
+                check_vma=False,
+            )
+            return jax.jit(sm, donate_argnums=(1,))
+
+        return compile_for
+
     def _make_chunk_runner(self, chunk: int, max_rounds: int):
         """Fused IncEval segment for the guarded path: runs up to
         `chunk` supersteps of the SAME `shard_map(while_loop)` body as
@@ -296,42 +399,70 @@ class Worker:
                 check_vma=False,
             )
 
-            # the watchdog digest (and the stagnation residual) ride
-            # out of the chunk as extra outputs of the SAME jitted
-            # dispatch — computed on the global post-collective carry,
-            # so they are value-identical to the monitor's own probe
-            # (same carry_digest function, same masked-residual rule)
-            # and the guarded-fused path pays no extra device dispatch
-            # for them (ROADMAP "Watchdog on device")
-            from libgrape_lite_tpu.guard.watchdog import carry_digest
+            return _jit_with_chunk_digest(sm, state, eph)
 
-            float_keys = sorted(
-                k for k, v in state.items()
-                if k not in eph and np.dtype(v.dtype).kind == "f"
-            )
+        return compile_for
 
-            def with_digest(frag_stacked, st, eph_state, active0, r0):
-                out, rounds, active = sm(
-                    frag_stacked, st, eph_state, active0, r0
+    def _make_pipelined_chunk_runner(self, chunk: int, max_rounds: int):
+        """Software-pipelined twin of `_make_chunk_runner` (r9): the
+        exchange double buffer is re-derived from the entering carry at
+        every chunk entry (it is a pure function of the carry, so the
+        re-derivation is bitwise the value the previous chunk dropped)
+        and dropped at exit — chunk boundaries therefore remain the
+        SAME consistent cut as the serial chunked loop, and the
+        watchdog digest / masked residual emitted by this dispatch
+        observe the post-join carry (docs/PIPELINE.md).  Guard probes,
+        checkpoint snapshots and fault hooks all sit at that cut."""
+        app = self.app
+        mesh, frag_spec = self._mesh_layout()
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+
+        def stepper(frag_stacked, state, eph_state, active0, r0, squeezed):
+            frag = frag_stacked.local()
+            st_all = _squeeze_state({**state, **eph_state}, squeezed)
+            eph_vals = {k: st_all[k] for k in eph}
+
+            def strip(s):
+                return {k: v for k, v in s.items() if k not in eph}
+
+            ctx = StepContext()
+            st = strip(st_all)
+            xbuf = app.pipeline_exchange(ctx, frag, {**st, **eph_vals})
+            limit = jnp.int32(max_rounds if max_rounds > 0 else _INT32_MAX)
+            stop = jnp.minimum(jnp.int32(r0) + jnp.int32(chunk), limit)
+
+            def cond(carry):
+                _, _, act, r = carry
+                return jnp.logical_and(act > 0, r < stop)
+
+            def body(carry):
+                s, xb, _, r = carry
+                s2, a2, xb2 = app.inceval_pipelined(
+                    ctx, frag, {**s, **eph_vals}, xb
                 )
-                dig = carry_digest(out)
-                if float_keys:
-                    diffs = [
-                        jnp.max(jnp.where(
-                            jnp.isfinite(d), d, jnp.float32(0)
-                        ))
-                        for k in float_keys
-                        for d in [jnp.abs(
-                            out[k].astype(jnp.float32)
-                            - st[k].astype(jnp.float32)
-                        )]
-                    ]
-                    res = jnp.max(jnp.stack(diffs))
-                else:
-                    res = jnp.float32(-1)
-                return out, rounds, active, dig, res
+                return strip(s2), xb2, jnp.int32(a2), r + jnp.int32(1)
 
-            return jax.jit(with_digest)
+            st, _, active, rounds = lax.while_loop(
+                cond, body,
+                (st, xbuf, jnp.int32(active0), jnp.int32(r0)),
+            )
+            return _unsqueeze_state(st, squeezed), rounds, active
+
+        def compile_for(state):
+            specs, squeezed = self._key_specs(state)
+            carry_specs = {k: v for k, v in specs.items() if k not in eph}
+            eph_specs = {k: v for k, v in specs.items() if k in eph}
+            sm = compat.shard_map(
+                partial(stepper, squeezed=squeezed),
+                mesh=mesh,
+                in_specs=(frag_spec, carry_specs, eph_specs, P(), P()),
+                out_specs=(carry_specs, P(), P()),
+                check_vma=False,
+            )
+            # the SAME post-join digest/residual contract as the
+            # serial chunk runner — one shared wrapper, so the two
+            # guarded paths cannot drift apart
+            return _jit_with_chunk_digest(sm, state, eph)
 
         return compile_for
 
@@ -347,14 +478,25 @@ class Worker:
     def _state_struct(self, state):
         return state_struct(state)
 
+    def _pipelined(self):
+        """The app's resolved pipeline plan (r9), or None — the single
+        routing predicate for the fused/chunked loop bodies.  The plan
+        uid rides in `trace_key` (apps set `_pipeline_uid`), so serial
+        and pipelined compiles never share a cache entry."""
+        return getattr(self.app, "_pipeline", None)
+
     def _chunk_runner_for(self, chunk: int, max_rounds: int, state):
         key = (
             "chunk", chunk, max_rounds,
             self.app.trace_key(),
             self._state_struct(state),
         )
+        make = (
+            self._make_pipelined_chunk_runner
+            if self._pipelined() is not None else self._make_chunk_runner
+        )
         return self._cached_runner(
-            key, lambda: self._make_chunk_runner(chunk, max_rounds)(state)
+            key, lambda: make(chunk, max_rounds)(state)
         )
 
     def _runner_for(self, max_rounds: int, state):
@@ -370,8 +512,12 @@ class Worker:
             self.app.trace_key(),
             self._state_struct(state),
         )
+        make = (
+            self._make_pipelined_runner
+            if self._pipelined() is not None else self._make_runner
+        )
         return self._cached_runner(
-            key, lambda: self._make_runner(max_rounds)(state)
+            key, lambda: make(max_rounds)(state)
         )
 
     # ---- batched multi-source execution (serve/) -------------------------
@@ -853,6 +999,11 @@ class Worker:
         try:
             with tr.span("query", mode="fused",
                          app=type(app).__name__) as sp:
+                if tr.enabled and self._pipelined() is not None:
+                    # modeled overlap next to the measured dispatch/
+                    # device split, in the same record (r9):
+                    # trace_report derives overlap_hidden_us from it
+                    sp.set(pipeline=self._pipelined().span_brief())
                 out_state, rounds, active = runner(
                     frag.dev, carry, eph_part
                 )
@@ -866,6 +1017,13 @@ class Worker:
                     obs.metrics().counter(
                         "grape_supersteps_total"
                     ).inc(self.rounds + 1)
+                    if self._pipelined() is not None:
+                        # the modeled hidden-exchange split next to
+                        # the measured dispatch/device marks (r9):
+                        # trace_report's overlap column reads this
+                        sp.set(overlap_hidden_us=round(
+                            self._pipelined().hidden_us_per_round()
+                            * self.rounds, 1))
                 self._finish_query_obs(sp)
         finally:
             if tr.enabled:
@@ -1082,6 +1240,8 @@ class Worker:
         try:
             with tr.span("query", mode="guarded-fused",
                          app=type(app).__name__) as qsp:
+                if tr.enabled and self._pipelined() is not None:
+                    qsp.set(pipeline=self._pipelined().span_brief())
                 peval_fn = self._single_step_for("peval", state)
                 prev = carry_of(state)
                 with tr.span("peval") as sp:
@@ -1192,6 +1352,10 @@ class Worker:
                         fault_plan.on_superstep(rounds, ckpt)
                 self.rounds = rounds
                 self._terminate_code = min(0, int(active))
+                if tr.enabled and self._pipelined() is not None:
+                    qsp.set(overlap_hidden_us=round(
+                        self._pipelined().hidden_us_per_round()
+                        * self.rounds, 1))
                 self._finish_query_obs(qsp)
         finally:
             # flush in finally: a halt-policy breach raises out of the
@@ -1486,6 +1650,19 @@ class Worker:
                 f"{t['blocks']} blocks / {len(led['levels'])} levels "
                 f"(per-stage VPU ops/edge: {stages})",
             )
+            if "pipeline" in led:
+                p = led["pipeline"]
+                glog.vlog(
+                    1,
+                    "pipeline split: %d boundary / %d interior "
+                    "vertices (%d / %d edges), %s exchange, "
+                    "%d B/round",
+                    p.get("boundary_vertices", 0),
+                    p.get("interior_vertices", 0),
+                    p.get("boundary_edges", 0),
+                    p.get("interior_edges", 0),
+                    p.get("mode", "?"), p.get("exchange_bytes", 0),
+                )
         inc_fn = self._single_step_for("inceval", state)
         # ephemeral leaves drop out of each step's outputs; re-merge the
         # placed originals so the next step's inputs stay complete
@@ -1724,7 +1901,27 @@ class Worker:
         resolve SEVERAL dispatches (WCC pulls both directions) get the
         SUM of their ledgers: the per-round bill is every engaged
         plan's ops, and attributing only one would mislead the
-        measured-vs-modeled comparison."""
+        measured-vs-modeled comparison.
+
+        With a superstep pipeline resolved (r9) the ledger carries the
+        boundary-set stats under "pipeline" — boundary/interior
+        vertex+edge totals, exchange mode and modeled bytes — so the
+        plan's split is readable wherever the ledger is (the stepwise
+        vlog, obs query spans, trace_report)."""
+        def with_pipeline(led):
+            pl = self._pipelined()
+            if pl is None:
+                return led
+            return {**led, "pipeline": {
+                **pl.stats.get("totals", {}),
+                "mode": pl.mode,
+                "exchange_bytes": pl.exchange_bytes,
+            }}
+
+        # the pipelined round dispatches the split sub-plans instead
+        # of the full plan, but the split partitions the edge set, so
+        # the full plan's ledger below remains the honest per-round
+        # bill either way
         ledgers = []
         for attr in ("_pack", "_pack_ie", "_pack_oe"):
             d = getattr(self.app, attr, None)
@@ -1735,7 +1932,7 @@ class Worker:
         if not ledgers:
             return None
         if len(ledgers) == 1:
-            return ledgers[0]
+            return with_pipeline(ledgers[0])
         totals = {"vpu_ops": 0, "mxu_ops": 0, "gather_rows": 0,
                   "hbm_bytes": 0, "blocks": 0, "per_stage": {}}
         out = {"edges": 0, "levels": [], "totals": totals}
@@ -1756,7 +1953,7 @@ class Worker:
                 totals["per_stage"][k] = (
                     totals["per_stage"].get(k, 0) + v
                 )
-        return out
+        return with_pipeline(out)
 
     def resume(self, checkpoint_dir: str, max_rounds: int | None = None, *,
                checkpoint_every: int | None = None, fault_plan=None,

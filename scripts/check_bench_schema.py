@@ -42,6 +42,7 @@ _TOP = {
     "obs": (dict, False),
     "serve": (dict, False),
     "dyn": (dict, False),
+    "pipeline": (dict, False),
 }
 
 _SSSP = {
@@ -115,6 +116,32 @@ _DYN = {
     "inc_speedup": (_NUM, False),
 }
 
+# the r9 superstep-pipelining lane (parallel/pipeline.py,
+# docs/PIPELINE.md): serial vs pipelined wall at fnum>=2 with the
+# byte-identity verdict, the modeled hidden-exchange fraction from the
+# overlap term (t = max(compute_interior, exchange) + compute_boundary)
+# and the boundary-set sizes, plus the cost model's recount drift
+# (>5% fails the bench like the pack-ledger gate).  `byte_identical`
+# and `engaged` are DECLARED bool — everywhere else bool-in-numeric
+# stays rejected.
+_PIPELINE = {
+    "scale": (int, True),
+    "fnum": (int, True),
+    "app": (str, True),
+    "engaged": (bool, True),
+    "mode": (str, True),
+    "serial_s": (_NUM, True),
+    "pipelined_s": (_NUM, True),
+    "byte_identical": (bool, True),
+    "modeled_hidden_frac": (_NUM, True),
+    "exchange_bytes": (int, True),
+    "boundary_vertices": (int, True),
+    "interior_vertices": (int, True),
+    "boundary_edges": (int, True),
+    "interior_edges": (int, True),
+    "overlap_recount_mismatch": (_NUM, True),
+}
+
 _SPAN_ROLLUP = {
     "count": (int, True),
     "total_s": (_NUM, True),
@@ -130,6 +157,7 @@ SCHEMA = {
     "obs": _OBS,
     "serve": _SERVE,
     "dyn": _DYN,
+    "pipeline": _PIPELINE,
 }
 
 
@@ -172,7 +200,8 @@ def validate_record(record) -> list:
     _check_block(record, _TOP, "record", errors)
     for key, spec in (("sssp", _SSSP), ("guard", _GUARD),
                       ("pack_ledger", _PACK_LEDGER), ("obs", _OBS),
-                      ("serve", _SERVE), ("dyn", _DYN)):
+                      ("serve", _SERVE), ("dyn", _DYN),
+                      ("pipeline", _PIPELINE)):
         block = record.get(key)
         if isinstance(block, dict):
             _check_block(block, spec, key, errors)
@@ -283,7 +312,7 @@ def main(argv=None) -> int:
                     print(f"  - {e}")
             else:
                 blocks = [k for k in ("sssp", "guard", "pack_ledger",
-                                      "obs", "serve", "dyn")
+                                      "obs", "serve", "dyn", "pipeline")
                           if k in record]
                 print(f"OK {label} ({record.get('metric')}"
                       + (f"; blocks: {', '.join(blocks)}" if blocks

@@ -280,6 +280,61 @@ def bench_ledger_summary(scale: int, ef: int,
     return summary
 
 
+def overlap_recount(plan) -> dict:
+    """The exchange-overlap term (r9, parallel/pipeline.py), recounted
+    from the SHIPPED pipeline plan — the same discipline as
+    `independent_op_estimate`: the planner's boundary/interior stats
+    are annotations, so the boundary/interior edge counts are re-read
+    from the arrays that actually dispatch (the `pl_{b,i}_val`
+    validity planes on the XLA path, the sub-plan ledgers on the pack
+    path) and the exchange bytes from the plan's mode + geometry, NOT
+    from `plan.stats`.  Returns the recounted overlap model plus
+    `overlap_recount_mismatch`, gated at MISMATCH_TOLERANCE by
+    bench.py exactly like the op-budget ledger."""
+    from libgrape_lite_tpu.parallel.pipeline import overlap_model
+
+    if plan.pack_b is not None:
+        led_b = plan.pack_b.ledger()
+        led_i = plan.pack_i.ledger()
+        b_edges = int(led_b["edges"]) if led_b else 0
+        i_edges = int(led_i["edges"]) if led_i else 0
+    else:
+        b_edges = int(np.asarray(
+            plan.host_entries["pl_b_val"]).sum())
+        i_edges = int(np.asarray(
+            plan.host_entries["pl_i_val"]).sum())
+    # exchange bytes from mode + geometry (f32 payload convention,
+    # the same itemsize the shared mirror ledger prices)
+    if plan.mode == "mirror":
+        xbytes = plan.fnum * plan.m * 4
+    else:
+        xbytes = plan.fnum * plan.vp * 4
+    modeled = overlap_model(b_edges, i_edges, xbytes, plan.ops_per_edge)
+    t = plan.stats.get("totals", {})
+    planned = overlap_model(
+        t.get("boundary_edges", 0), t.get("interior_edges", 0),
+        plan.exchange_bytes, plan.ops_per_edge,
+    )
+    mismatch = max(
+        abs(b_edges - t.get("boundary_edges", 0))
+        / max(1, t.get("boundary_edges", 0)),
+        abs(i_edges - t.get("interior_edges", 0))
+        / max(1, t.get("interior_edges", 0)),
+        abs(xbytes - plan.exchange_bytes)
+        / max(1, plan.exchange_bytes),
+        abs(modeled["hidden_frac"] - planned["hidden_frac"])
+        / max(1e-9, planned["hidden_frac"] or 1.0),
+    )
+    return {
+        "boundary_edges": b_edges,
+        "interior_edges": i_edges,
+        "exchange_bytes": xbytes,
+        "modeled_hidden_frac": modeled["hidden_frac"],
+        "modeled_round_speedup": modeled["round_speedup"],
+        "overlap_recount_mismatch": round(mismatch, 4),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=int, default=20)

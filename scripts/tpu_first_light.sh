@@ -55,6 +55,17 @@ GRAPE_BENCH_ASSUME_ALIVE=1 GRAPE_SPMV=pack GRAPE_PACK_SCAN=shift \
   timeout 3600 python bench.py \
   2> "$OUT/bench_shift.err" | tee "$OUT/bench_shift.json" || true
 
+echo "== pipeline A/B (GRAPE_PIPELINE=0 vs 1 — superstep software
+pipelining, parallel/pipeline.py; the bench's own pipeline lane runs
+the serial-vs-pipelined pair at fnum>=2 and gates on byte identity +
+the overlap-term recount; docs/PIPELINE.md) =="
+GRAPE_BENCH_ASSUME_ALIVE=1 GRAPE_PIPELINE=0 timeout 3600 python bench.py \
+  2> "$OUT/bench_pipe0.err" | tee "$OUT/bench_pipe0.json" || true
+GRAPE_BENCH_ASSUME_ALIVE=1 GRAPE_PIPELINE=1 timeout 3600 python bench.py \
+  2> "$OUT/bench_pipe1.err" | tee "$OUT/bench_pipe1.json" || true
+grep -h "\[bench\] pipeline" "$OUT/bench_pipe0.err" \
+  "$OUT/bench_pipe1.err" | tail -4 || true
+
 echo "== per-stage profile (stepwise mode, per-round wall clock) =="
 GRAPE_SPMV=pack GRAPE_TPU_VLOG=1 timeout 1200 python - <<'EOF' 2>&1 | tee "$OUT/profile.log" || true
 import sys

@@ -270,6 +270,149 @@ def test_r5_passes_with_explicit_bool_rejection():
     assert "R5" not in _rules(src)
 
 
+# ---- R6: pipelined-window carry reads -------------------------------------
+
+
+def test_r6_trips_on_unnamed_window_read():
+    # state["frontier"] is read AFTER the exchange kickoff and is not
+    # named in parallel/pipeline.PIPELINE_WINDOW_READS — the aliasing
+    # class the double buffer exists to prevent
+    src = """
+    def inceval_pipelined(self, ctx, frag, state, xbuf):
+        new_b = state["dist"] + 1
+        xbuf2 = self._pipeline.kickoff(ctx, new_b, state)
+        fr = state["frontier"]
+        return {"dist": new_b + fr}, 1, xbuf2
+    """
+    assert "R6" in _rules(src)
+
+
+def test_r6_trips_on_pre_kickoff_alias_read_in_window():
+    # the carry leaf is bound to a local BEFORE the kickoff and read
+    # after it — same unaudited window read, via an alias
+    src = """
+    def inceval_pipelined(self, ctx, frag, state, xbuf):
+        shadow = state["scratch"]
+        xbuf2 = self._pipeline.kickoff(ctx, state["dist"], state)
+        return {"dist": shadow}, 1, xbuf2
+    """
+    assert "R6" in _rules(src)
+
+
+def test_r6_passes_on_contract_named_reads():
+    # every window read is in the shipped contract: the carry leaf
+    # ("dist"), the join mask ("pl_bmask"), the interior streams
+    # ("pl_i_*") and the pack sub-plan prefix ("pki_*")
+    src = """
+    def inceval_pipelined(self, ctx, frag, state, xbuf):
+        dist = state["dist"]
+        xbuf2 = self._pipeline.kickoff(ctx, dist, state)
+        cand = state["pl_i_nbr"] + state["pki_l0_rows"]
+        new = cand * state["pl_bmask"] + dist
+        return {"dist": new}, 1, xbuf2
+    """
+    assert "R6" not in _rules(src)
+
+
+def test_r6_trips_on_nested_closure_read():
+    # the unnamed read hides inside a nested helper that CAPTURES the
+    # carry dict; its call lands after the kickoff, so the read is a
+    # window read even though its source line is earlier — audited
+    # position-independently
+    src = """
+    def inceval_pipelined(self, ctx, frag, state, xbuf):
+        def helper():
+            return state["frontier"]
+        pre = state["dist"]
+        xbuf2 = self._pipeline.kickoff(ctx, pre, state)
+        return {"dist": helper()}, 1, xbuf2
+    """
+    assert "R6" in _rules(src)
+
+
+def test_r6_trips_on_whole_carry_escape():
+    # passing the ENTIRE carry dict to a callee the contract does not
+    # name: R6 cannot see the callee's body, so the escape itself is
+    # the finding
+    src = """
+    def inceval_pipelined(self, ctx, frag, state, xbuf):
+        new_b = state["dist"] + 1
+        xbuf2 = self._pipeline.kickoff(ctx, new_b, state)
+        out = self.mystery_fold(frag, state)
+        return {"dist": out}, 1, xbuf2
+    """
+    assert "R6" in _rules(src)
+
+
+def test_r6_passes_on_audited_callees():
+    # reduce (pack sub-plan dispatch) and round_update (PageRank) are
+    # named in PIPELINE_WINDOW_CALLEES — whole-carry passes to them
+    # are audited, in the main body and in nested helpers alike
+    src = """
+    def inceval_pipelined(self, ctx, frag, state, xbuf):
+        def pack_fold(dispatch, table):
+            return dispatch.reduce(table, state, "min")
+        full = self._pipeline.splice(ctx, state["rank"], state, xbuf)
+        xbuf2 = self._pipeline.kickoff(ctx, state["rank"], state)
+        cur = pack_fold(self._pipeline.pack_i, full)
+        st2, active = self.round_update(frag, state, cur)
+        return st2, active, xbuf2
+    """
+    assert "R6" not in _rules(src)
+
+
+def test_r6_non_dict_params_do_not_trip_escape():
+    # frag/ctx are never subscripted with string keys, so passing them
+    # whole to helpers is not a carry escape
+    src = """
+    def inceval_pipelined(self, ctx, frag, state, xbuf):
+        xbuf2 = self._pipeline.kickoff(ctx, state["dist"], state)
+        deg = self.degree_of(frag, ctx)
+        return {"dist": state["dist"] + deg}, 1, xbuf2
+    """
+    assert "R6" not in _rules(src)
+
+
+def test_r6_ignores_functions_without_kickoff():
+    # no pipelined window, no rule: the serial inceval reads the carry
+    # freely
+    src = """
+    def inceval(self, ctx, frag, state):
+        return {"dist": state["anything_at_all"]}, 1
+    """
+    assert "R6" not in _rules(src)
+
+
+def test_r6_reads_before_kickoff_are_free():
+    # the boundary slice (before the kickoff) may read any carry leaf:
+    # the exchange has not been kicked off yet, nothing is in flight
+    src = """
+    def inceval_pipelined(self, ctx, frag, state, xbuf):
+        pre = state["unnamed_leaf"] + state["another_one"]
+        xbuf2 = self._pipeline.kickoff(ctx, pre, state)
+        return {"dist": state["dist"]}, 1, xbuf2
+    """
+    assert "R6" not in _rules(src)
+
+
+def test_r6_shipped_incevals_are_clean():
+    # zero-entry baseline: every shipped inceval_pipelined's window
+    # reads are named in the worker pipeline contract
+    import os
+
+    import libgrape_lite_tpu
+
+    root = os.path.dirname(libgrape_lite_tpu.__file__)
+    for mod in ("models/sssp.py", "models/bfs.py", "models/wcc.py",
+                "models/pagerank.py"):
+        path = os.path.join(root, mod)
+        with open(path) as fh:
+            src = fh.read()
+        assert "inceval_pipelined" in src
+        r6 = [f for f in lint_source(src, mod) if f.rule == "R6"]
+        assert not r6, f"{mod}: {[f.message for f in r6]}"
+
+
 # ---- baseline round-trip --------------------------------------------------
 
 
