@@ -64,6 +64,18 @@ from the pre-delta fixed point against a cold recompute.  Env knobs:
 GRAPE_BENCH_NO_DYN=1 skips, GRAPE_BENCH_DYN_SCALE /
 GRAPE_BENCH_DYN_UPDATES size the lane.
 
+BENCH-json partition2d fields (r10): `partition2d` carries the 1-D
+edge-cut vs 2-D vertex-cut A/B (fragment/partition.py, models/
+vc2d.py, docs/PARTITION2D.md) on a hub-heavy RMAT at fnum 4 (k=2) —
+max-tile edge count vs the raw 1-D hub fragment (the SCALE_NOTES
+pathology), modeled exchange bytes under the shared ledgers,
+serial-vs-2D wall, SSSP byte-identity / PageRank eps-identity
+verdicts, the planner's recorded auto decision against the measured
+winner, and the per-tile pack-plan ledger recount (the 5% gate).
+Env knobs: GRAPE_BENCH_NO_P2D=1 skips, GRAPE_BENCH_P2D_SCALE sizes
+the twin (default 12 regardless of GRAPE_BENCH_SCALE — hub
+statistics under-develop below that).
+
 Baseline derivation (BASELINE.md): the reference GPU backend runs
 PageRank on soc-LiveJournal1 (68.99M directed edges) in 24.65 ms on
 8× V100 (`Performance.md:94`), i.e. 68.99e6 * 10 rounds / 0.02465 s
@@ -295,6 +307,219 @@ def pipeline_lane(scale: int) -> dict:
             overlap_recount(plan)["overlap_recount_mismatch"]
         )
     return block
+
+
+def partition2d_lane(scale: int) -> dict:
+    """The 1-D edge-cut vs 2-D vertex-cut A/B (r10, ROADMAP item 2;
+    fragment/partition.py, models/vc2d.py, docs/PARTITION2D.md) on a
+    hub-heavy RMAT at fnum 4 (k=2):
+
+      * `hub_1d_edges` — the max 1-D shard edge count on the RAW
+        degree-correlated id space: the recorded pathology
+        (docs/SCALE_NOTES.md) every shard's padding pays;
+      * the WALL A/B runs on the SHUFFLED id space (gen_rmat
+        shuffle_perm — the honest best-case 1-D baseline, satellite
+        of this PR): SSSP serial-1-D vs 2-D best-of-3, byte-identity
+        of per-oid results, PageRank 1-D vs PageRankVC eps-identity;
+      * the planner's recorded auto decision (modeled costs from the
+        shared rate/byte ledgers) against the measured winner — walls
+        within PARTITION_TIE_BAND count as agreeing with the planner:
+        the model prices TPU rates, and a CPU-fallback wall split
+        finer than the band is collective-dispatch noise, not signal;
+      * the per-tile pack sub-plan ledger recount
+        (pack_cost_model.tile_plan_recount), gated at the same 5%.
+    """
+    import jax
+
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.fragment.partition import resolve_partition
+    from libgrape_lite_tpu.fragment.vertexcut import (
+        ImmutableVertexcutFragment,
+    )
+    from libgrape_lite_tpu.models import (
+        PageRank,
+        PageRankVC,
+        SSSP,
+        SSSPVC2D,
+    )
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.partitioner import (
+        SegmentedPartitioner,
+    )
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    fnum, k = 4, 2
+    if jax.device_count() < fnum:
+        raise RuntimeError("partition2d lane needs >= 4 devices")
+    scripts = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    from gen_rmat import shuffle_perm
+    from pack_cost_model import tile_plan_recount
+
+    n, src_raw, dst_raw = rmat_edges(scale, EDGE_FACTOR)
+    # the recorded pathology: max 1-D shard ie-edge count on the raw
+    # degree-correlated ids (contiguous-range partitioner convention)
+    shard_w = max(1, -(-n // fnum))
+    d_sym = np.concatenate([dst_raw, src_raw])
+    hub_1d = int(np.bincount(
+        np.minimum(d_sym // shard_w, fnum - 1), minlength=fnum
+    ).max())
+
+    perm = shuffle_perm(n)
+    src, dst = perm[src_raw], perm[dst_raw]
+    rng_w = np.random.default_rng(11)
+    w = rng_w.uniform(0.1, 10.0, size=len(src)).astype(np.float32)
+    oids = np.arange(n, dtype=np.int64)
+
+    comm = CommSpec(fnum=fnum)
+    vm = VertexMap.build(oids, SegmentedPartitioner(fnum, oids))
+    frag_1d = ShardedEdgecutFragment.build(
+        comm, vm, src, dst, w, directed=False,
+        load_strategy=LoadStrategy.kBothOutIn,
+    )
+    max_1d = int(np.bincount(
+        np.minimum(np.concatenate([dst, src]) // shard_w, fnum - 1),
+        minlength=fnum,
+    ).max())
+    frag_2d = ImmutableVertexcutFragment.build(
+        comm, oids, src, dst, w, directed=False, symmetrize=True,
+    )
+    tiles = frag_2d.tile_stats()
+
+    def assembled(worker, frag):
+        vals = worker.result_values()
+        out = np.full(n, np.nan, dtype=vals.dtype)
+        for f in range(frag.fnum):
+            m = frag.inner_vertices_num(f)
+            if m:
+                out[np.asarray(frag.inner_oids(f))] = vals[f, :m]
+        return out
+
+    def best_of(app, frag, n_meas=3, **kw):
+        worker = Worker(app, frag)
+        worker.query(**kw)  # warm (compile + plan)
+        best = float("inf")
+        for _ in range(n_meas):
+            t0 = time.perf_counter()
+            worker.query(**kw)
+            best = min(best, time.perf_counter() - t0)
+        return best, assembled(worker, frag)
+
+    t_1d, res_1d = best_of(SSSP(), frag_1d, source=0)
+    t_2d, res_2d = best_of(SSSPVC2D(), frag_2d, source=0)
+    byte_identical = res_1d.tobytes() == res_2d.tobytes()
+
+    # PageRank: sum folds regroup across tiles -> eps, not bytes (the
+    # documented pipeline-SUM class of decline)
+    _, pr_1d = best_of(PageRank(delta=0.85, max_round=10), frag_1d,
+                       n_meas=1, max_round=10)
+    frag_2d_raw = ImmutableVertexcutFragment.build(
+        comm, oids, src, dst, None, directed=False,
+    )
+    _, pr_2d = best_of(PageRankVC(), frag_2d_raw, n_meas=1,
+                       delta=0.85, max_round=10)
+    # the repo's eps convention (tests/verifiers.py eps_verify, from
+    # the reference's eps_check.cc): 1e-4 relative — the bench runs
+    # f32 (x64 off), so f64-tight bounds would misread f32 epsilon
+    # accumulation as divergence
+    pr_rel = float(np.max(
+        np.abs(pr_1d - pr_2d) / np.maximum(np.abs(pr_1d), 1e-300)
+    ))
+
+    decision = resolve_partition(
+        "sssp", fnum, src, dst, oids, directed=False, mode="auto"
+    )
+    costs = decision["costs"]
+    planner_choice = decision["mode"]
+    measured_winner = "2d" if t_2d < t_1d else "1d"
+    tie = abs(t_2d - t_1d) / max(min(t_2d, t_1d), 1e-9) \
+        <= PARTITION_TIE_BAND
+    decision_matches = (planner_choice == measured_winner) or tie
+
+    # tile-plan availability and recount drift are DISTINCT verdicts:
+    # a failed resolve must not masquerade as ledger drift
+    disp = None
+    try:
+        from libgrape_lite_tpu.ops.spmv_pack import resolve_pack_dispatch
+
+        disp = resolve_pack_dispatch(
+            frag_2d, direction="ie", prefix="pk_ie_",
+            with_weights=True, role=f"vc2d-k{k}",
+        )
+    except Exception as e:
+        print(f"[bench] partition2d: tile plan failed: {e}",
+              file=sys.stderr)
+    recount = (
+        tile_plan_recount(disp.mplan) if disp is not None
+        else {"tile_recount_mismatch": 1.0}
+    )
+
+    return {
+        "scale": scale,
+        "fnum": fnum,
+        "k": k,
+        "app": "sssp",
+        "hub_1d_edges": hub_1d,
+        "max_1d_edges": max_1d,
+        "max_tile_edges": tiles["max_tile_edges"],
+        "tile_skew": tiles["tile_skew"],
+        "tile_ratio_vs_hub": round(
+            tiles["max_tile_edges"] / max(1, hub_1d), 4),
+        "tile_bound_ok": tiles["max_tile_edges"] <= 0.5 * hub_1d,
+        "exchange_bytes_1d": costs["1d"]["exchange_bytes"],
+        "exchange_bytes_2d": costs["2d"]["exchange_bytes"],
+        "exchange_reduced": (
+            costs["2d"]["exchange_bytes"] < costs["1d"]["exchange_bytes"]
+        ),
+        "serial_1d_s": round(t_1d, 4),
+        "vc2d_s": round(t_2d, 4),
+        "sssp_byte_identical": byte_identical,
+        "pagerank_max_rel_err": pr_rel,
+        "pagerank_eps_identical": pr_rel < 1e-4,
+        "planner_choice": planner_choice,
+        "planner_t1d_s": costs["1d"]["t_round_s"],
+        "planner_t2d_s": costs["2d"]["t_round_s"],
+        "measured_winner": measured_winner,
+        "decision_matches": decision_matches,
+        "tile_plan_ok": disp is not None,
+        "tile_recount_mismatch": recount["tile_recount_mismatch"],
+    }
+
+
+# measured walls within this band of each other count as agreeing
+# with the planner's modeled choice: the model prices TPU VPU/ICI
+# rates, and a CPU-fallback split finer than this is dispatch noise
+PARTITION_TIE_BAND = 0.25
+
+
+def _partition2d_lane_subprocess(scale: int) -> dict:
+    """Run the lane in a fresh CPU process with a forced 4-device
+    host platform (same pattern as the pipeline lane: the CPU-fallback
+    bench holds a 1-device backend, frozen at init)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--partition2d-lane", str(scale)],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"partition2d-lane subprocess failed: "
+            f"{r.stderr.strip()[-500:]}"
+        )
+    return json.loads(r.stdout.strip().splitlines()[-1])
 
 
 def _pipeline_lane_subprocess(scale: int) -> dict:
@@ -853,6 +1078,74 @@ def main():
                 file=sys.stderr,
             )
 
+    # 2-D vertex-cut partition lane (r10, ROADMAP item 2): the
+    # hub-heavy RMAT A/B at fnum 4 (k=2) — max-tile vs the raw hub
+    # fragment, modeled exchange bytes, serial-vs-2D wall, byte/eps
+    # identity verdicts, the planner's recorded auto decision against
+    # the measured winner, and the per-tile pack-plan recount (gated
+    # at the shared 5% tolerance).  GRAPE_BENCH_NO_P2D=1 skips;
+    # GRAPE_BENCH_P2D_SCALE sizes the twin.
+    p2d_mismatch = None
+    if not os.environ.get("GRAPE_BENCH_NO_P2D"):
+        try:
+            # default 12 REGARDLESS of GRAPE_BENCH_SCALE: the lane's
+            # tile-vs-hub bound is a statement about RMAT hub
+            # statistics, which under-develop below scale ~12 (at
+            # scale 10 the raw hub fragment is only ~2x the mean and
+            # the 0.5x bound sits on the noise floor)
+            p2d_scale = int(os.environ.get(
+                "GRAPE_BENCH_P2D_SCALE", 12))
+            if jax.device_count() >= 4:
+                p2d = partition2d_lane(p2d_scale)
+            else:
+                p2d = _partition2d_lane_subprocess(p2d_scale)
+            record["partition2d"] = p2d
+            _emit_record(record)
+            print(
+                f"[bench] partition2d: 1d={p2d['serial_1d_s']}s "
+                f"2d={p2d['vc2d_s']}s byte_identical="
+                f"{p2d['sssp_byte_identical']} max_tile="
+                f"{p2d['max_tile_edges']} vs hub={p2d['hub_1d_edges']} "
+                f"({p2d['tile_ratio_vs_hub']}x) planner="
+                f"{p2d['planner_choice']} measured="
+                f"{p2d['measured_winner']}",
+                file=sys.stderr,
+            )
+            scripts = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "scripts")
+            if scripts not in sys.path:
+                sys.path.insert(0, scripts)
+            from pack_cost_model import MISMATCH_TOLERANCE as _TOL2
+
+            for bad, why in (
+                (not p2d["sssp_byte_identical"],
+                 "2-D SSSP diverged from the 1-D result"),
+                (not p2d["pagerank_eps_identical"],
+                 "2-D PageRank drifted past eps"),
+                (not p2d["tile_bound_ok"],
+                 "max tile exceeds 0.5x the 1-D hub fragment"),
+                (not p2d["exchange_reduced"],
+                 "modeled 2-D exchange bytes not below the 1-D "
+                 "gather"),
+                (not p2d["tile_plan_ok"],
+                 "per-tile pack plan unavailable (resolve failed — "
+                 "see the lane's stderr)"),
+                (p2d["tile_plan_ok"]
+                 and p2d["tile_recount_mismatch"] > _TOL2,
+                 "tile pack-plan ledger recount drifted"),
+                (not p2d["decision_matches"],
+                 "planner decision contradicts the measured winner"),
+            ):
+                if bad:
+                    p2d_mismatch = why
+                    break
+        except Exception as e:  # the lane must not cost the bench
+            print(
+                f"[bench] partition2d lane failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+
     # static op-budget ledger (r6): the planner's exact per-stage ALU
     # counts at the bench geometry ride in the BENCH json, and the
     # cost model's independent recount must agree within 5% — the
@@ -958,6 +1251,13 @@ def main():
             file=sys.stderr,
         )
         sys.exit(2)
+    if p2d_mismatch is not None:
+        print(
+            f"[bench] FATAL: partition2d lane verdict failed: "
+            f"{p2d_mismatch} — see the partition2d block above",
+            file=sys.stderr,
+        )
+        sys.exit(2)
     if _SCHEMA_ERRORS:
         print(
             f"[bench] FATAL: {len(_SCHEMA_ERRORS)} BENCH-record schema "
@@ -974,5 +1274,9 @@ if __name__ == "__main__":
         # parent's backend is frozen at 1 device); prints ONE json line
         _i = sys.argv.index("--pipeline-lane")
         print(json.dumps(pipeline_lane(int(sys.argv[_i + 1]))))
+    elif "--partition2d-lane" in sys.argv:
+        # subprocess entrypoint for the 1-D vs 2-D partition A/B
+        _i = sys.argv.index("--partition2d-lane")
+        print(json.dumps(partition2d_lane(int(sys.argv[_i + 1]))))
     else:
         main()

@@ -35,7 +35,7 @@ def _round_up(x: int, m: int) -> int:
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["src", "dst", "w", "mask"],
-    meta_fields=["fnum", "k", "vc", "chunk"],
+    meta_fields=["fnum", "k", "vc", "chunk", "total_vnum"],
 )
 @dataclass
 class VCDeviceFragment:
@@ -50,6 +50,8 @@ class VCDeviceFragment:
     k: int
     vc: int  # padded chunk width
     chunk: int  # real chunk width (oid space / k)
+    # real vertex count (guard/ monitor's active-range ceiling)
+    total_vnum: int = 0
 
     @property
     def n_pad(self) -> int:
@@ -61,13 +63,16 @@ class VCDeviceFragment:
             w=None if self.w is None else self.w[0],
             mask=self.mask[0],
             fnum=self.fnum, k=self.k, vc=self.vc, chunk=self.chunk,
+            total_vnum=self.total_vnum,
         )
 
 
 class ImmutableVertexcutFragment:
     """Host descriptor for the full 2-D partitioned graph."""
 
-    def __init__(self, comm_spec, dev, oids, k, vc, chunk, total_enum):
+    def __init__(self, comm_spec, dev, oids, k, vc, chunk, total_enum,
+                 directed: bool = True, weighted: bool = False,
+                 symmetrized: bool = False):
         self.comm_spec = comm_spec
         self.dev = dev
         self.k = k
@@ -81,16 +86,100 @@ class ImmutableVertexcutFragment:
             np.sort(self._oids[(self._oids // chunk) == c]) for c in range(k)
         ]
         self.total_vnum = len(self._oids)
+        # traversal semantics of the stored tile blocks: `directed`
+        # mirrors the loader flag; `symmetrized` says the blocks hold
+        # BOTH (u,v) and (v,u) per input edge (min-fold pulls use one
+        # dst-side pull per round — the 1-D undirected-CSR convention);
+        # PageRankVC-style gather-scatter apps keep raw storage and
+        # accumulate both directions in-app instead
+        self.directed = directed
+        self.weighted = weighted
+        self.symmetrized = symmetrized
+        self._host_csrs = {}
 
     def oid_to_gpid(self, oids: np.ndarray) -> np.ndarray:
         oids = np.asarray(oids)
         return (oids // self.chunk) * self.vc + (oids % self.chunk)
+
+    def gpid_to_oid(self, gpids: np.ndarray) -> np.ndarray:
+        """Inverse of `oid_to_gpid` — gpid order is oid order (chunks
+        are contiguous oid ranges and offset < chunk <= vc), which is
+        what makes the 2-D WCC representative the min-OID member."""
+        gpids = np.asarray(gpids)
+        return (gpids // self.vc) * self.chunk + (gpids % self.vc)
 
     def vertex_mask(self) -> np.ndarray:
         """[k * vc] bool: which gpid slots are real vertices."""
         m = np.zeros(self.k * self.vc, dtype=bool)
         m[self.oid_to_gpid(self._oids)] = True
         return m
+
+    # ---- per-tile CSR views -------------------------------------------
+    #
+    # The pack planner (ops/spmv_pack.resolve_pack_dispatch) and the ft
+    # fingerprint read fragments through the host_ie/host_oe CSR-list
+    # protocol; the vertex-cut tiles expose the same shape so the MXU
+    # scan / stream-diet machinery of PRs 2/4 applies per tile:
+    #   host_ie[f]: rows = dst offsets in chunk-j space, cols = src
+    #               offsets in chunk-i space (the dst-side pull whose
+    #               gather table is the [vc] column-broadcast chunk);
+    #   host_oe[f]: the transposed orientation (src-side pull — the
+    #               directed-WCC second direction).
+    # Both index LOCAL [vc] tables, so pack plans are built with
+    # n_cols = vc (`pack_n_cols`), not fnum * vp.
+
+    @property
+    def pack_n_cols(self) -> int:
+        return self.vc
+
+    def _tile_csrs(self, orientation: str):
+        if orientation in self._host_csrs:
+            return self._host_csrs[orientation]
+        from libgrape_lite_tpu.graph.csr import build_csr
+
+        s_arr, d_arr, w_arr, m_arr = self._host_tiles
+        rows_all, cols_all = (
+            (d_arr, s_arr) if orientation == "ie" else (s_arr, d_arr)
+        )
+        csrs = []
+        ep = s_arr.shape[1]
+        for f in range(self.fnum):
+            m = m_arr[f]
+            csrs.append(build_csr(
+                (rows_all[f][m] % self.vc).astype(np.int64),
+                (cols_all[f][m] % self.vc).astype(np.int64),
+                None if w_arr is None else w_arr[f][m],
+                self.vc, ep,
+            ))
+        self._host_csrs[orientation] = csrs
+        return csrs
+
+    @property
+    def host_ie(self):
+        return self._tile_csrs("ie")
+
+    @property
+    def host_oe(self):
+        return self._tile_csrs("oe")
+
+    def tile_stats(self) -> dict:
+        """Per-tile real edge counts + the skew summary the planner,
+        the bench `partition2d` lane and trace_report all read —
+        the 2-D analogue of edgecut's partition-skew warning."""
+        _, _, _, m_arr = self._host_tiles
+        counts = m_arr.sum(axis=1).astype(int)
+        mean = max(float(counts.mean()), 1.0)
+        return {
+            "k": self.k,
+            "per_tile": [
+                {"tile": f, "row": f // self.k, "col": f % self.k,
+                 "edges": int(c)}
+                for f, c in enumerate(counts)
+            ],
+            "max_tile_edges": int(counts.max()),
+            "mean_tile_edges": round(mean, 1),
+            "tile_skew": round(float(counts.max()) / mean, 3),
+        }
 
     # masters: the diagonal fragment (c, c) owns chunk c
     # (reference partitioner.h:269-330 master placement)
@@ -111,7 +200,15 @@ class ImmutableVertexcutFragment:
         dst_oid: np.ndarray,
         weights: np.ndarray | None = None,
         edata_dtype=np.float64,
+        directed: bool = True,
+        symmetrize: bool = False,
     ) -> "ImmutableVertexcutFragment":
+        """`symmetrize=True` stores BOTH (u,v) -> tile (cu,cv) and
+        (v,u) -> tile (cv,cu) per input edge, so one dst-side pull per
+        round covers the undirected traversal (the 1-D loader's
+        symmetrised-CSR convention; min folds stay byte-identical).
+        The default keeps raw storage — the seed contract PageRankVC's
+        both-direction gather-scatter accumulation depends on."""
         fnum = comm_spec.fnum
         k = int(round(np.sqrt(fnum)))
         if k * k != fnum:
@@ -122,6 +219,13 @@ class ImmutableVertexcutFragment:
 
         src = np.asarray(src_oid)
         dst = np.asarray(dst_oid)
+        real_enum = len(src)
+        if symmetrize:
+            src, dst = (
+                np.concatenate([src, dst]), np.concatenate([dst, src])
+            )
+            if weights is not None:
+                weights = np.concatenate([weights, weights])
         bad = (src < 0) | (src >= space) | (dst < 0) | (dst >= space)
         if bad.any():
             ex = np.stack([src[bad], dst[bad]], 1)[:3]
@@ -159,6 +263,14 @@ class ImmutableVertexcutFragment:
 
         dev = VCDeviceFragment(
             src=put(s_arr), dst=put(d_arr), w=put(w_arr), mask=put(m_arr),
-            fnum=fnum, k=k, vc=vc, chunk=chunk,
+            fnum=fnum, k=k, vc=vc, chunk=chunk, total_vnum=len(oids),
         )
-        return cls(comm_spec, dev, oids, k, vc, chunk, len(src))
+        out = cls(comm_spec, dev, oids, k, vc, chunk, real_enum,
+                  directed=directed, weighted=weights is not None,
+                  symmetrized=symmetrize)
+        # host tile blocks stay resident: the per-tile CSR views
+        # (host_ie/host_oe), tile_stats and the ft content fingerprint
+        # all read them — the edge-cut fragment keeps its host CSRs the
+        # same way
+        out._host_tiles = (s_arr, d_arr, w_arr, m_arr)
+        return out

@@ -31,6 +31,7 @@ from libgrape_lite_tpu.models.pagerank_vc import (
     PageRankVC,
     PageRankVCReplicated,
 )
+from libgrape_lite_tpu.models.vc2d import BFSVC2D, SSSPVC2D, WCCVC2D
 from libgrape_lite_tpu.models.lcc_directed import LCCDirected
 from libgrape_lite_tpu.models.wcc_opt import WCCOpt
 from libgrape_lite_tpu.models.sssp_msg import BFSMsg, SSSPMsg
@@ -107,4 +108,10 @@ APP_REGISTRY = {
     # _rep keeps the mesh-replicated round-1 formulation for A/B
     "pagerank_vc": PageRankVC,
     "pagerank_vc_rep": PageRankVCReplicated,
+    # 2-D vertex-cut min-fold apps (models/vc2d.py, ROADMAP item 2):
+    # byte-identical to the 1-D pulls; selected by GRAPE_PARTITION
+    # via fragment/partition.resolve_partition
+    "sssp_vc": SSSPVC2D,
+    "bfs_vc": BFSVC2D,
+    "wcc_vc": WCCVC2D,
 }

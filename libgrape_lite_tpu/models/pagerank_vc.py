@@ -41,17 +41,9 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from libgrape_lite_tpu.app.base import GatherScatterAppBase, StepContext
+from libgrape_lite_tpu.models.vc2d import vc_transpose as _transpose
 from libgrape_lite_tpu.parallel.comm_spec import VC_COL_AXIS, VC_ROW_AXIS
 from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
-
-
-def _transpose(x, k):
-    """Swap row/col sharding of a chunk-sharded per-device block: device
-    (i, j) exchanges with (j, i) — one ppermute over the joint axis."""
-    if k == 1:
-        return x
-    perm = [(i * k + j, j * k + i) for i in range(k) for j in range(k)]
-    return lax.ppermute(x, (VC_ROW_AXIS, VC_COL_AXIS), perm)
 
 
 class PageRankVC(GatherScatterAppBase):
@@ -78,6 +70,12 @@ class PageRankVC(GatherScatterAppBase):
             self.delta = delta
         if max_round is not None:
             self.max_round = max_round
+        # partition fingerprint (r10): keys the runner cache apart
+        # from any 1-D compile and feeds the obs query span's tile
+        # record (trace_report's tile table)
+        self._partition = "2d"
+        self._mesh_k = frag.k
+        self._partition_stats = frag.tile_stats()
         n_pad = frag.dev.n_pad
         vmask = frag.vertex_mask()
         return {

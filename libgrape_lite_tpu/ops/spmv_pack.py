@@ -2491,7 +2491,15 @@ def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
         )
 
     cols_override = mirror.nbr_compact if mirror is not None else None
-    n_cols = mirror.n_compact if mirror is not None else frag.fnum * frag.vp
+    # 2-D vertex-cut tiles (fragment/vertexcut.py) gather from the
+    # LOCAL [vc] column-broadcast chunk, not the [fnum*vp] all-gather
+    # table — the fragment declares its pass-table width
+    tile_cols = getattr(frag, "pack_n_cols", None)
+    n_cols = (
+        mirror.n_compact if mirror is not None
+        else tile_cols if tile_cols is not None
+        else frag.fnum * frag.vp
+    )
     shards = []
     for f in range(frag.fnum):
         shard = _shard_edges(frag, f, with_weights, direction,
@@ -2505,9 +2513,9 @@ def resolve_pack_dispatch(frag, cfg: PackConfig | None = None,
         PLAN_STATS["disk_cache_hits"] += 1
     else:
         PLAN_STATS["planned"] += 1
-        if row_mask is not None:
-            # sub-plans always take the multi planner (uniform
-            # skeleton over the filtered per-shard streams)
+        if row_mask is not None or tile_cols is not None:
+            # sub-plans and per-tile plans always take the multi
+            # planner (uniform skeleton over the per-shard streams)
             mplan = plan_pack_multi(shards, frag.vp, n_cols, cfg)
         elif mirror is not None:
             mplan = plan_pack_multi(shards, frag.vp, n_cols, cfg)

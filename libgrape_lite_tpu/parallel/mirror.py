@@ -60,6 +60,21 @@ def exchange_bytes_ledger(fnum: int, vp: int, m: int | None = None,
     }
 
 
+def vc2d_exchange_bytes(k: int, vc: int, itemsize: int = 4,
+                        pulls: int = 1) -> int:
+    """Per-round per-device ICI bytes of the 2-D vertex-cut round
+    (fragment/partition.py's side of THE shared exchange model — the
+    1-D side is `exchange_bytes_ledger`).  Per pull: one ring psum of
+    the [vc] partials along k row peers (2*(k-1)/k * vc payload) plus
+    one transpose ppermute ((1 - 1/k) * vc average — diagonal devices
+    self-map).  The asymptotic point of SparseP's 2-D argument: this
+    is O(N/k) per device where the 1-D gather is O(N)."""
+    if k <= 1:
+        return 0
+    per_pull = (2 * (k - 1) / k + (1 - 1 / k)) * vc * itemsize
+    return int(round(pulls * per_pull))
+
+
 def pipelined_round_s(compute_interior_s: float, exchange_s: float,
                       compute_boundary_s: float) -> float:
     """The software-pipelined round's modeled wall time:

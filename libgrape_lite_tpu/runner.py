@@ -145,6 +145,87 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
 
     from libgrape_lite_tpu.utils.types import MessageStrategy
 
+    # 1-D vs 2-D partition choice (fragment/partition.py, ROADMAP
+    # item 2): consulted ONLY when GRAPE_PARTITION asks — the default
+    # path stays byte-for-byte the program it always was.  An engaged
+    # decision swaps in the registered 2-D twin and the vertex-cut
+    # fragment; EVERY declined request records its reason (never
+    # silent), and the structurally-cheap declines (wrong app, non-
+    # square fnum, string ids, delta load) are recorded WITHOUT
+    # reading the edge file.
+    vc2d_inputs = None
+    if not args.vc:
+        from libgrape_lite_tpu.fragment.partition import (
+            VC2D_APPS,
+            partition_mode,
+            precheck_partition,
+            resolve_partition,
+        )
+
+        if partition_mode() != "1d":
+            empty = np.zeros(0, dtype=np.int64)
+            if args.delta_efile or args.delta_vfile:
+                resolve_partition(
+                    name, comm_spec.fnum, empty, empty, empty,
+                    directed=args.directed, string_id=args.string_id,
+                    eligible=False,
+                    reason="delta-mutation load has no vertex-cut path",
+                )
+            elif args.serialize or args.deserialize or not args.efile:
+                # the garc serialization cache is an edge-cut artifact
+                # (loader.py writes/reads it inside LoadGraph, which
+                # the 2-D path bypasses) — and a deserialize run may
+                # carry no edge file at all; decline with the reason
+                # recorded rather than crash or silently skip the
+                # cache write
+                resolve_partition(
+                    name, comm_spec.fnum, empty, empty, empty,
+                    directed=args.directed, string_id=args.string_id,
+                    eligible=False,
+                    reason="serialization cache flags (or no edge "
+                           "file): the vertex-cut fragment has no "
+                           "serialized form",
+                )
+            elif precheck_partition(
+                name, comm_spec.fnum, directed=args.directed,
+                string_id=args.string_id,
+            ) is not None:
+                # structurally ineligible: record the decline cheaply
+                # (resolve_partition re-derives the same reason before
+                # touching the arrays)
+                resolve_partition(
+                    name, comm_spec.fnum, empty, empty, empty,
+                    directed=args.directed, string_id=args.string_id,
+                )
+            else:
+                from libgrape_lite_tpu.io.line_parser import (
+                    read_edge_file,
+                    read_vertex_file,
+                )
+
+                with timer.phase("partition probe"):
+                    p_src, p_dst, p_w = read_edge_file(
+                        args.efile, weighted=weighted
+                    )
+                    p_oids = (
+                        read_vertex_file(args.vfile)
+                        if args.vfile
+                        else np.unique(np.concatenate([p_src, p_dst]))
+                    )
+                    decision = resolve_partition(
+                        name, comm_spec.fnum, p_src, p_dst, p_oids,
+                        directed=args.directed,
+                    )
+                if decision["engaged"]:
+                    name = VC2D_APPS[name]
+                    app = APP_REGISTRY[name]()
+                    vc2d_inputs = (p_src, p_dst, p_w, p_oids)
+                # an auto decline on modeled cost falls through to the
+                # 1-D loader, which re-reads the file — the probe is
+                # opt-in (GRAPE_PARTITION set) and the arrays cannot
+                # seed LoadGraph's partitioner/idxer pipeline without
+                # replicating it here
+
     is_vc = app_cls.message_strategy == MessageStrategy.kGatherScatter
     if args.vc and not is_vc:
         raise ValueError(
@@ -163,7 +244,26 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
         )
 
     with timer.phase("load graph"):
-        if is_vc:
+        if vc2d_inputs is not None:
+            from libgrape_lite_tpu.fragment.vertexcut import (
+                ImmutableVertexcutFragment,
+            )
+
+            src, dst, w, oids = vc2d_inputs
+            # min-fold pulls get symmetrised tiles (the 1-D loader's
+            # undirected-CSR convention; WCC symmetrises even when
+            # directed — weak connectivity IS the undirected
+            # traversal); pagerank_vc keeps raw storage and
+            # accumulates both directions in-app
+            sym = (
+                name == "wcc_vc"
+                or (name != "pagerank_vc" and not args.directed)
+            )
+            frag = ImmutableVertexcutFragment.build(
+                comm_spec, oids, src, dst, w if weighted else None,
+                directed=args.directed, symmetrize=sym,
+            )
+        elif is_vc:
             from libgrape_lite_tpu.fragment.vertexcut import (
                 ImmutableVertexcutFragment,
             )

@@ -1148,6 +1148,19 @@ class Worker:
             m.gauge("grape_pack_hbm_bytes").set(led["hbm_bytes"])
             m.gauge("grape_pack_vpu_ops").set(led["vpu_ops"])
             m.gauge("grape_pack_mxu_ops").set(led["mxu_ops"])
+        # 2-D vertex-cut queries attach their tile layout to the query
+        # span (r10): trace_report renders per-tile rows + the
+        # max-tile-skew column from exactly this record
+        part = getattr(self.app, "_partition_stats", None)
+        if part is not None:
+            sp.set(partition={
+                "mode": getattr(self.app, "_partition", "2d"),
+                "k": part["k"],
+                "max_tile_edges": part["max_tile_edges"],
+                "mean_tile_edges": part["mean_tile_edges"],
+                "tile_skew": part["tile_skew"],
+                "per_tile": part["per_tile"],
+            })
         # guard probe/breach/rollback counts live in the counters the
         # monitor itself maintains at the event sites — no duplicate
         # gauges here that could disagree after an aborted query

@@ -109,6 +109,21 @@ for app_spec in "sssp --sssp_source=6" "bfs --bfs_source=6"; do
   echo "  OK (byte-identical to serial)"
 done
 
+echo "== 2-D vertex-cut partition: cmp-identical to 1-D (sssp, fnum=4) =="
+# GRAPE_PARTITION=2d routes sssp through the k x k vertex-cut mesh
+# (fragment/partition.py + models/vc2d.py); min folds regroup exactly
+# across tiles, so the merged result files must be bit-identical to
+# the serial 1-D run's (docs/PARTITION2D.md)
+run 4 sssp --sssp_source=6
+cp "$OUT/merged.res" "$OUT/serial_1d.res"
+( export GRAPE_PARTITION=2d; run 4 sssp --sssp_source=6 )
+cmp "$OUT/serial_1d.res" "$OUT/merged.res" \
+  || { echo "2-D VERTEX-CUT RESULT DIVERGED FROM 1-D" >&2; exit 1; }
+echo "  OK (byte-identical to the 1-D edge-cut)"
+# declined geometry (fnum=2 is not a square) must fall back to 1-D
+# with the reason recorded, never error out
+( export GRAPE_PARTITION=2d; run 2 sssp --sssp_source=6 ); verify exact p2p-31-SSSP
+
 echo "== guard self-heal drill (corrupt_carry + rollback-replay) =="
 python scripts/fault_drill.py --self-heal --apps sssp,pagerank,wcc
 

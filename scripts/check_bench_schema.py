@@ -43,6 +43,7 @@ _TOP = {
     "serve": (dict, False),
     "dyn": (dict, False),
     "pipeline": (dict, False),
+    "partition2d": (dict, False),
 }
 
 _SSSP = {
@@ -142,6 +143,41 @@ _PIPELINE = {
     "overlap_recount_mismatch": (_NUM, True),
 }
 
+# the r10 2-D vertex-cut partition lane (fragment/partition.py,
+# models/vc2d.py, docs/PARTITION2D.md): hub-heavy RMAT A/B at fnum 4
+# (k=2) — max-tile vs the raw 1-D hub fragment, modeled exchange
+# bytes under the shared ledgers, serial-vs-2D wall, byte/eps
+# identity verdicts, the planner's recorded auto decision vs the
+# measured winner, and the per-tile pack-plan recount drift (the 5%
+# gate).  Verdict fields are DECLARED bool, like the pipeline lane's.
+_PARTITION2D = {
+    "scale": (int, True),
+    "fnum": (int, True),
+    "k": (int, True),
+    "app": (str, True),
+    "hub_1d_edges": (int, True),
+    "max_1d_edges": (int, True),
+    "max_tile_edges": (int, True),
+    "tile_skew": (_NUM, True),
+    "tile_ratio_vs_hub": (_NUM, True),
+    "tile_bound_ok": (bool, True),
+    "exchange_bytes_1d": (int, True),
+    "exchange_bytes_2d": (int, True),
+    "exchange_reduced": (bool, True),
+    "serial_1d_s": (_NUM, True),
+    "vc2d_s": (_NUM, True),
+    "sssp_byte_identical": (bool, True),
+    "pagerank_max_rel_err": (_NUM, True),
+    "pagerank_eps_identical": (bool, True),
+    "planner_choice": (str, True),
+    "planner_t1d_s": (_NUM, True),
+    "planner_t2d_s": (_NUM, True),
+    "measured_winner": (str, True),
+    "decision_matches": (bool, True),
+    "tile_plan_ok": (bool, True),
+    "tile_recount_mismatch": (_NUM, True),
+}
+
 _SPAN_ROLLUP = {
     "count": (int, True),
     "total_s": (_NUM, True),
@@ -158,6 +194,7 @@ SCHEMA = {
     "serve": _SERVE,
     "dyn": _DYN,
     "pipeline": _PIPELINE,
+    "partition2d": _PARTITION2D,
 }
 
 
@@ -201,7 +238,8 @@ def validate_record(record) -> list:
     for key, spec in (("sssp", _SSSP), ("guard", _GUARD),
                       ("pack_ledger", _PACK_LEDGER), ("obs", _OBS),
                       ("serve", _SERVE), ("dyn", _DYN),
-                      ("pipeline", _PIPELINE)):
+                      ("pipeline", _PIPELINE),
+                      ("partition2d", _PARTITION2D)):
         block = record.get(key)
         if isinstance(block, dict):
             _check_block(block, spec, key, errors)
@@ -220,6 +258,14 @@ def validate_record(record) -> list:
                 f"pack_ledger.scan_mode: {led.get('scan_mode')!r} not in "
                 "('mxu', 'shift')"
             )
+    p2 = record.get("partition2d")
+    if isinstance(p2, dict):
+        for f in ("planner_choice", "measured_winner"):
+            if p2.get(f) not in (None, "1d", "2d"):
+                errors.append(
+                    f"partition2d.{f}: {p2.get(f)!r} not in "
+                    "('1d', '2d')"
+                )
     ob = record.get("obs")
     if isinstance(ob, dict) and isinstance(ob.get("spans"), dict):
         for name, r in ob["spans"].items():
@@ -312,7 +358,8 @@ def main(argv=None) -> int:
                     print(f"  - {e}")
             else:
                 blocks = [k for k in ("sssp", "guard", "pack_ledger",
-                                      "obs", "serve", "dyn", "pipeline")
+                                      "obs", "serve", "dyn", "pipeline",
+                                      "partition2d")
                           if k in record]
                 print(f"OK {label} ({record.get('metric')}"
                       + (f"; blocks: {', '.join(blocks)}" if blocks

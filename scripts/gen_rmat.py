@@ -17,6 +17,16 @@ fresh RMAT draws over the SAME vertex universe with a separate seed —
 additive-only, so they ride the overlay side-path; the serve CLI
 ingests the file via --delta_stream and bench.py's dyn lane measures
 updates/sec against exactly this distribution.
+
+`--shuffle_ids` applies a seeded permutation (`--shuffle_seed`) to the
+vertex id space before writing: raw RMAT ids are degree-correlated
+(low ids are hubs — a=0.57 biases every bit toward 0), which makes
+any contiguous-range partitioner put the hubs on one shard and every
+shard pay that shard's padded Ep (3.2x waste at scale 24,
+docs/SCALE_NOTES.md).  The shuffle breaks the correlation
+reproducibly, so a 1-D baseline measured on the shuffled file is the
+HONEST best-case edge-cut — the comparison the bench `partition2d`
+lane runs its 2-D A/B against (docs/PARTITION2D.md).
 """
 
 from __future__ import annotations
@@ -45,6 +55,11 @@ def main(argv=None) -> int:
     p.add_argument("--delta_out", default="",
                    help="path for the --delta update stream")
     p.add_argument("--delta_seed", type=int, default=101)
+    p.add_argument("--shuffle_ids", action="store_true",
+                   help="apply a seeded permutation to the vertex id "
+                        "space (breaks RMAT's degree-id correlation; "
+                        "the honest 1-D baseline for 2-D A/Bs)")
+    p.add_argument("--shuffle_seed", type=int, default=53)
     args = p.parse_args(argv)
     if args.delta and not args.delta_out:
         p.error("--delta requires --delta_out")
@@ -53,6 +68,11 @@ def main(argv=None) -> int:
 
     t0 = time.perf_counter()
     n, src, dst = rmat_edges(args.scale, args.edge_factor, args.seed)
+    if args.shuffle_ids:
+        perm = shuffle_perm(n, args.shuffle_seed)
+        src, dst = perm[src], perm[dst]
+        print(f"[gen_rmat] shuffled ids (seed {args.shuffle_seed})",
+              flush=True)
     print(f"[gen_rmat] generated {len(src):,} edges over {n:,} vertices "
           f"in {time.perf_counter() - t0:.1f}s", flush=True)
 
@@ -78,6 +98,10 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         d_src, d_dst = delta_edges(args.scale, args.delta,
                                    args.delta_seed)
+        if args.shuffle_ids:
+            # the update stream lives in the same (shuffled) id space
+            # as the base graph it mutates
+            d_src, d_dst = perm[d_src], perm[d_dst]
         rng_dw = np.random.default_rng(args.delta_seed + 1)
         with open(args.delta_out, "w") as f:
             if args.weighted:
@@ -92,6 +116,13 @@ def main(argv=None) -> int:
               f"{args.delta_out} in {time.perf_counter() - t0:.1f}s",
               flush=True)
     return 0
+
+
+def shuffle_perm(n: int, seed: int = 53) -> np.ndarray:
+    """The reproducible id permutation behind --shuffle_ids — shared
+    with bench.py's partition2d lane so the benched id space IS the
+    scripted one."""
+    return np.random.default_rng(seed).permutation(n)
 
 
 def delta_edges(scale: int, n_ops: int, seed: int):

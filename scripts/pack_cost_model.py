@@ -88,6 +88,88 @@ def build_bench_plan(scale: int, ef: int):
     return plan_pack(rows, cols, vp, vp, PackConfig.from_env())
 
 
+def _decode_shift_stages(fl: np.ndarray) -> int:
+    """Span-aware scan stage count, re-derived from one block's flag
+    plane (the independent decode both recounts share)."""
+    e = int(((fl & 1) > 0).sum())
+    if not e:
+        return 0
+    starts = np.flatnonzero((fl & 2) > 0)
+    runs = np.diff(np.concatenate([starts, [e]]))
+    mx = int(runs.max()) if len(runs) else 1
+    return max(0, math.ceil(math.log2(max(1, mx))))
+
+
+def _recount_level(d: dict, nb: int, sub: int, tot: dict,
+                   stage_override=None) -> None:
+    """Recount ONE level's blocks from its stacked stream dict
+    ([nb, ...] leading block axis) into `tot` — the shared core of the
+    single-plan and multi-plan (2-D tile) recounts, so the two gates
+    can never codify different conventions.
+
+    `stage_override[b]`, when given, replaces the per-block flag
+    decode for shift-scan stages: under shard_map every shard runs ONE
+    traced program, so plan_pack_multi unifies each block's stages to
+    the cross-shard max before ledgering — the multi recount must
+    price the unified count (decoded independently per shard, then
+    maxed by the caller), not each shard's own."""
+    slots = sub * C
+    for b in range(nb):
+        ops = 0
+        # merge/restore route: one sublane move when composed
+        # lane-aligned, else the three stages at their heights
+        if "rr" in d:
+            ops += slots
+        else:
+            ops += (d["l1"].shape[-2] + d["s2"].shape[-2]
+                    + d["l3"].shape[-2]) * C
+        if "ps" in d:
+            # mxu level: flat restoration cost — 10 VPU ops and 3
+            # matmul output planes per slot, HARDCODED here as the
+            # independent codification of the documented
+            # convention (importing spmv_pack's constants would
+            # make this gate tautological: a planner-side constant
+            # drift must trip the 5% mismatch, not follow it).
+            # The ps/bk planes are also decoded for consistency:
+            # the derived start flag (ps == lane & bk == 0) must
+            # mark at least one start per block that ships edges.
+            ops += 10 * slots
+            tot["mxu_ops"] += 3 * slots
+            ps = d["ps"][b].astype(np.int64)
+            bk = d["bk"][b].astype(np.int64)
+            lane = np.arange(C, dtype=np.int64)[None, :]
+            f0 = (ps == lane) & (bk == 0)
+            assert f0.any(), (
+                "mxu restoration planes decode to zero segment "
+                "starts — ps/bk are corrupt"
+            )
+        else:
+            fl = d["flags"][b].reshape(-1).astype(np.int64)
+            ops += slots  # the flags != 1 compare
+            # span-aware scan stages, re-derived from the flags (or
+            # the caller's cross-shard unified count — see docstring)
+            if stage_override is not None:
+                stages = stage_override[b]
+            else:
+                stages = _decode_shift_stages(fl)
+            ops += 3 * stages * slots
+        # extraction: compact eroute (no validity select) or
+        # final row-range tiles (select survives: tile outputs
+        # sum straight into the dense result)
+        if "el1" in d:
+            ops += (d["el1"].shape[-2] + d["es2"].shape[-2]
+                    + d["el3"].shape[-2]) * C
+        elif "tel1" in d:
+            nt = d["tel1"].shape[1]
+            ops += nt * (d["tel1"].shape[-2] + d["tes2"].shape[-2]
+                         + 2 * d["teval"].shape[-2]) * C
+        if "gidx" in d:
+            # hub-group reduce + the two hub-table gathers
+            ops += 3 * slots
+            tot["gather_rows"] += slots
+        tot["vpu_ops"] += ops
+
+
 def independent_op_estimate(plan) -> dict:
     """Recount VPU ops, MXU elems and gather rows from the SHIPPED
     device stream arrays, independently of the planner's BlockPlan
@@ -105,67 +187,74 @@ def independent_op_estimate(plan) -> dict:
     for lv in levels:
         if not lv.blocks:
             continue
-        d = _stack_blocks(lv)
-        nb = len(lv.blocks)
-        slots = lv.cfg.sub * C
-        for b in range(nb):
-            ops = 0
-            # merge/restore route: one sublane move when composed
-            # lane-aligned, else the three stages at their heights
-            if "rr" in d:
-                ops += slots
-            else:
-                ops += (d["l1"].shape[-2] + d["s2"].shape[-2]
-                        + d["l3"].shape[-2]) * C
-            if "ps" in d:
-                # mxu level: flat restoration cost — 10 VPU ops and 3
-                # matmul output planes per slot, HARDCODED here as the
-                # independent codification of the documented
-                # convention (importing spmv_pack's constants would
-                # make this gate tautological: a planner-side constant
-                # drift must trip the 5% mismatch, not follow it).
-                # The ps/bk planes are also decoded for consistency:
-                # the derived start flag (ps == lane & bk == 0) must
-                # mark at least one start per block that ships edges.
-                ops += 10 * slots
-                tot["mxu_ops"] += 3 * slots
-                ps = d["ps"][b].astype(np.int64)
-                bk = d["bk"][b].astype(np.int64)
-                lane = np.arange(C, dtype=np.int64)[None, :]
-                f0 = (ps == lane) & (bk == 0)
-                assert f0.any(), (
-                    "mxu restoration planes decode to zero segment "
-                    "starts — ps/bk are corrupt"
-                )
-            else:
-                fl = d["flags"][b].reshape(-1).astype(np.int64)
-                ops += slots  # the flags != 1 compare
-                # span-aware scan stages, re-derived from the flags
-                e = int(((fl & 1) > 0).sum())
-                if e:
-                    starts = np.flatnonzero((fl & 2) > 0)
-                    runs = np.diff(np.concatenate([starts, [e]]))
-                    mx = int(runs.max()) if len(runs) else 1
-                    stages = max(0, math.ceil(math.log2(max(1, mx))))
-                else:
-                    stages = 0
-                ops += 3 * stages * slots
-            # extraction: compact eroute (no validity select) or
-            # final row-range tiles (select survives: tile outputs
-            # sum straight into the dense result)
-            if "el1" in d:
-                ops += (d["el1"].shape[-2] + d["es2"].shape[-2]
-                        + d["el3"].shape[-2]) * C
-            elif "tel1" in d:
-                nt = d["tel1"].shape[1]
-                ops += nt * (d["tel1"].shape[-2] + d["tes2"].shape[-2]
-                             + 2 * d["teval"].shape[-2]) * C
-            if "gidx" in d:
-                # hub-group reduce + the two hub-table gathers
-                ops += 3 * slots
-                tot["gather_rows"] += slots
-            tot["vpu_ops"] += ops
+        _recount_level(_stack_blocks(lv), len(lv.blocks), lv.cfg.sub,
+                       tot)
     return tot
+
+
+def independent_multi_estimate(mplan) -> dict:
+    """`independent_op_estimate` for a MultiPackPlan — the form every
+    per-tile (2-D vertex-cut) and per-shard plan ships in.  The level
+    streams ride stacked as `L{i}_{name}` [fnum, nb, ...] host arrays;
+    the recount decodes every shard's slice with the SAME per-level
+    core as the single-plan gate (r10)."""
+    tot = {"vpu_ops": 0, "mxu_ops": 0, "gather_rows": 0}
+    for i, skel in enumerate(mplan.skels):
+        prefix = f"L{i}_"
+        names = [
+            k[len(prefix):] for k in mplan.host_streams
+            if k.startswith(prefix)
+        ]
+        if not names:
+            continue
+        shards = [
+            {n: mplan.host_streams[prefix + n][f] for n in names}
+            for f in range(mplan.fnum)
+        ]
+        # shift-scan levels: every shard runs ONE traced program, so
+        # the planner unifies each block's stage count to the
+        # cross-shard max (spmv_pack.plan_pack_multi) — decode each
+        # shard's stages independently, then price the unified max
+        # (extra stages are bit-exact no-ops for the shard that
+        # needed fewer, but they execute and the ledger bills them)
+        stage_override = None
+        if "flags" in shards[0]:
+            stage_override = [
+                max(
+                    _decode_shift_stages(
+                        d["flags"][b].reshape(-1).astype(np.int64)
+                    )
+                    for d in shards
+                )
+                for b in range(skel.nb)
+            ]
+        for d in shards:
+            _recount_level(d, skel.nb, mplan.cfg.sub, tot,
+                           stage_override=stage_override)
+    return tot
+
+
+def tile_plan_recount(mplan) -> dict:
+    """The 2-D tile-plan gate (bench `partition2d` lane): the per-tile
+    MultiPackPlan's ledger totals vs the independent recount from its
+    shipped streams, mismatch gated at MISMATCH_TOLERANCE exactly like
+    the 1-D op-budget ledger."""
+    rec = independent_multi_estimate(mplan)
+    totals = (mplan.ledger or {}).get("totals")
+    if not totals:
+        return {"tile_recount_mismatch": 1.0,
+                "reason": "tile plan ships no ledger"}
+    mismatch = max(
+        abs(totals[k] - rec[k]) / max(1, totals[k])
+        for k in ("vpu_ops", "mxu_ops")
+    )
+    return {
+        "tile_recount_mismatch": round(mismatch, 4),
+        "ledger_vpu_ops": totals["vpu_ops"],
+        "recount_vpu_ops": rec["vpu_ops"],
+        "ledger_mxu_ops": totals["mxu_ops"],
+        "recount_mxu_ops": rec["mxu_ops"],
+    }
 
 
 def price(totals: dict, edges: int) -> dict:

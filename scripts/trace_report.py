@@ -22,6 +22,10 @@ GRAPE_TRACE / --trace / obs.configure and prints:
   `ovl_ms` overlap column (hidden-exchange time per superstep), and a
   PIPELINE DRIFT flag when pipelining is armed but hides <10% of the
   exchange;
+* the 2-D vertex-cut tile table when the query span carries one
+  (r10, docs/PARTITION2D.md): one labeled row per (row, col) tile
+  with its edge count and share of the max tile, plus the
+  max-tile-skew summary;
 * a phase rollup (obs.rollup) for the non-superstep spans.
 
 Usage: python scripts/trace_report.py TRACE [--drift-x 2.0]
@@ -106,6 +110,19 @@ def query_ledger(events):
             if "pack_ledger" in args:
                 led = args["pack_ledger"]
     return led
+
+
+def query_partition(events):
+    """The 2-D vertex-cut tile record of the last query span that
+    carried one (r10: the worker attaches `partition` when the app
+    ran the 2-D mesh), or None."""
+    pt = None
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("name") == "query":
+            args = ev.get("args") or {}
+            if "partition" in args:
+                pt = args["partition"]
+    return pt
 
 
 def query_pipeline(events):
@@ -213,6 +230,29 @@ def render(events, drift_x: float = DRIFT_X, out=sys.stdout):
                 "interior slice is too small to cover the collective "
                 "(hub-heavy cut? see docs/PIPELINE.md: the split "
                 "costs a dispatch and buys almost nothing here)",
+                file=out,
+            )
+    part = query_partition(events)
+    if part:
+        # 2-D vertex-cut tile table (r10, docs/PARTITION2D.md): one
+        # row per tile with its share of the max-tile skew — the
+        # per-tile analogue of the partition-skew warning, read from
+        # the SAME record the worker attached to the query span
+        k = part.get("k", 0)
+        mx = max(1, part.get("max_tile_edges", 1))
+        print(
+            f"\npartition2d tiles (k={k}, "
+            f"max {part.get('max_tile_edges', 0)} / mean "
+            f"{part.get('mean_tile_edges', 0)} edges, skew "
+            f"{part.get('tile_skew', 0.0):.3f}x):",
+            file=out,
+        )
+        print(f"{'tile':>10} {'edges':>10} {'x_max':>7}", file=out)
+        for t in part.get("per_tile", []):
+            label = f"({t.get('row', '?')},{t.get('col', '?')})"
+            print(
+                f"{label:>10} {t.get('edges', 0):>10} "
+                f"{t.get('edges', 0) / mx:>7.2f}",
                 file=out,
             )
     if flagged:
