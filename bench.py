@@ -76,6 +76,21 @@ Env knobs: GRAPE_BENCH_NO_P2D=1 skips, GRAPE_BENCH_P2D_SCALE sizes
 the twin (default 12 regardless of GRAPE_BENCH_SCALE — hub
 statistics under-develop below that).
 
+BENCH-json spgemm fields (r11): `spgemm` carries the masked-SpGEMM
+lane (ops/spgemm_pack.py, docs/SPGEMM.md) — LCC intersect-vs-spgemm
+wall A/B at GRAPE_BENCH_SPGEMM_SCALE (default min(SCALE, 10)) with
+the bit-exactness verdict, the shipped-plan ledger recount (the 5%
+gate), plan-time pruning stats (items / items_per_edge over the
+oriented mask edges), and the MODELED ops/edge A/B at full bench
+geometry: `mxu_elems_per_edge` + `vpu_ops_per_edge` for the spgemm
+pipeline vs `intersect_word_ops_per_edge` for the popcount sweep
+(per mask edge; the intersect bitmap is O(N²/8) bytes at scale 20 —
+physically unbuildable, which is the breadth ceiling the primitive
+lifts), priced into `modeled_*_s` with the `modeled_win` verdict and
+the ledger-auto decision at lane geometry.  Env knobs:
+GRAPE_BENCH_NO_SPGEMM=1 skips, GRAPE_BENCH_SPGEMM_SCALE sizes the
+executed A/B.
+
 Baseline derivation (BASELINE.md): the reference GPU backend runs
 PageRank on soc-LiveJournal1 (68.99M directed edges) in 24.65 ms on
 8× V100 (`Performance.md:94`), i.e. 68.99e6 * 10 rounds / 0.02465 s
@@ -88,6 +103,7 @@ TEPS counts each edge once per query).  vs_baseline = ours / theirs.
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -200,6 +216,101 @@ def build_bench_weighted_fragment(src, dst, comm_spec, vm,
         load_strategy=LoadStrategy.kBothOutIn,
         retain_edge_list=retain_edge_list,
     )
+
+
+def spgemm_lane(scale: int, bench_scale: int, ef: int) -> dict:
+    """The r11 masked-SpGEMM lane (ops/spgemm_pack.py, ROADMAP 5a):
+    LCC intersect-vs-spgemm wall A/B at the lane geometry with the
+    bit-exactness verdict and the shipped-plan recount, plus the
+    MODELED ops/edge A/B at full bench geometry (plan_only — the
+    intersect bitmap is O(N^2/8) bytes there, physically unbuildable,
+    which is exactly the ceiling the primitive lifts)."""
+    import libgrape_lite_tpu.ops.spgemm_pack as sg
+    from libgrape_lite_tpu.models import APP_REGISTRY
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    n, src, dst, comm_spec, vm, frag = build_bench_fragment(scale)
+    prev_backend = os.environ.get("GRAPE_LCC_BACKEND")
+
+    def restore():
+        if prev_backend is None:
+            os.environ.pop("GRAPE_LCC_BACKEND", None)
+        else:
+            os.environ["GRAPE_LCC_BACKEND"] = prev_backend
+
+    def best_of(backend: str, n_meas: int = 2):
+        os.environ["GRAPE_LCC_BACKEND"] = backend
+        try:
+            app = APP_REGISTRY["lcc_bitmap"]()
+            wk = Worker(app, frag)
+            wk.query()  # compile + plan
+            best = math.inf
+            for _ in range(n_meas):
+                t0 = time.perf_counter()
+                wk.query()
+                best = min(best, time.perf_counter() - t0)
+            return best, wk.result_values()
+        finally:
+            restore()
+
+    t_int, r_int = best_of("intersect")
+    t_sp, r_sp = best_of("spgemm")
+    byte_identical = bool(np.array_equal(r_int, r_sp))
+
+    # recount gate on the EXECUTED plan's shipped streams
+    scripts = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    from pack_cost_model import spgemm_recount
+
+    plan = sg.resolve_spgemm_dispatch(frag).plan
+    rec = spgemm_recount(plan)
+
+    # modeled A/B at FULL bench geometry (plan_only: counts + ledger,
+    # no stream materialization)
+    from libgrape_lite_tpu.models.lcc import _lcc_chunk
+
+    lcc_chunk = _lcc_chunk()  # the intersect model must price the
+    # chunk a real query would run (GRAPE_LCC_CHUNK), not a literal
+    bn, bsrc, bdst = rmat_edges(bench_scale, ef)
+    bplan = sg.plan_spgemm_edges(bsrc, bdst, bn, plan_only=True)
+    n_pad_b = bplan.n_pad
+    ep_sym = 2 * len(bsrc)
+    b_int = sg.intersect_ledger_geom(
+        n_pad_b, ep_sym, ep_sym, 1, n_pad_b, lcc_chunk)
+    prices = sg.price_backends(bplan.ledger, b_int)
+    me = max(1, bplan.mask_edges)
+    # the auto decision AT LANE GEOMETRY, recorded like any query's
+    os.environ["GRAPE_LCC_BACKEND"] = "auto"
+    try:
+        auto_backend = sg.resolve_lcc_backend("LCC", frag,
+                                              chunk=lcc_chunk)
+    finally:
+        restore()
+    return {
+        "scale": scale,
+        "bench_scale": bench_scale,
+        "intersect_s": round(t_int, 4),
+        "spgemm_s": round(t_sp, 4),
+        "byte_identical": byte_identical,
+        "items": int(plan.items),
+        "items_per_edge": float(plan.stats["items_per_edge"]),
+        "mask_edges": int(plan.mask_edges),
+        "ledger_recount_mismatch": rec["spgemm_recount_mismatch"],
+        # per MASK (oriented dedup) edge, at bench geometry
+        "bench_mask_edges": int(bplan.mask_edges),
+        "bench_items_per_edge": float(bplan.stats["items_per_edge"]),
+        "mxu_elems_per_edge": round(
+            bplan.ledger["totals"]["mxu_ops"] / me, 1),
+        "vpu_ops_per_edge": round(
+            bplan.ledger["totals"]["vpu_ops"] / me, 1),
+        "intersect_word_ops_per_edge": round(b_int["word_ops"] / me, 1),
+        "modeled_spgemm_s": round(prices["t_spgemm_s"], 6),
+        "modeled_intersect_s": round(prices["t_intersect_s"], 6),
+        "modeled_win": bool(prices["spgemm_wins"]),
+        "auto_backend": auto_backend,
+    }
 
 
 def pipeline_lane(scale: int) -> dict:
@@ -1146,6 +1257,60 @@ def main():
                 file=sys.stderr,
             )
 
+    # masked-SpGEMM lane (r11, ROADMAP 5a): LCC intersect-vs-spgemm
+    # wall A/B at GRAPE_BENCH_SPGEMM_SCALE (default min(SCALE, 10))
+    # with the bit-exactness verdict + shipped-plan recount, and the
+    # modeled ops/edge A/B at the full bench geometry.  Gated like the
+    # ledger lane: recount drift > 5%, a non-identical result, or a
+    # modeled LOSS against popcount fails the bench with exit 2.
+    # GRAPE_BENCH_NO_SPGEMM=1 skips.
+    spgemm_mismatch = None
+    if not os.environ.get("GRAPE_BENCH_NO_SPGEMM"):
+        try:
+            sg_scale = int(os.environ.get(
+                "GRAPE_BENCH_SPGEMM_SCALE", min(SCALE, 10)))
+            sgb = spgemm_lane(sg_scale, SCALE, EDGE_FACTOR)
+            record["spgemm"] = sgb
+            _emit_record(record)
+            print(
+                f"[bench] spgemm: intersect={sgb['intersect_s']}s "
+                f"spgemm={sgb['spgemm_s']}s byte_identical="
+                f"{sgb['byte_identical']} modeled@{SCALE}: "
+                f"mxu/edge={sgb['mxu_elems_per_edge']} vs popcount "
+                f"word-ops/edge={sgb['intersect_word_ops_per_edge']} "
+                f"win={sgb['modeled_win']} auto={sgb['auto_backend']}",
+                file=sys.stderr,
+            )
+            scripts = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "scripts")
+            if scripts not in sys.path:
+                sys.path.insert(0, scripts)
+            from pack_cost_model import MISMATCH_TOLERANCE as _TOLS
+
+            # the modeled-win verdict gates only at/above the
+            # crossover scale (~2^13 vertices, docs/SPGEMM.md): below
+            # it the packed-bitmap sweep SHOULD win and auto records
+            # the intersect decline — a shrunken GRAPE_BENCH_SCALE
+            # smoke (app_tests runs scale 10) must not read an
+            # expected loss as drift.  Identity + recount gate always.
+            for bad, why in (
+                (not sgb["byte_identical"],
+                 "spgemm LCC diverged from the intersect backend"),
+                (sgb["ledger_recount_mismatch"] > _TOLS,
+                 "spgemm ledger recount drifted"),
+                (SCALE >= 14 and not sgb["modeled_win"],
+                 "modeled spgemm cost does not beat popcount at bench "
+                 "geometry"),
+            ):
+                if bad:
+                    spgemm_mismatch = why
+                    break
+        except Exception as e:  # the lane must not cost the bench
+            print(
+                f"[bench] spgemm lane failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+
     # static op-budget ledger (r6): the planner's exact per-stage ALU
     # counts at the bench geometry ride in the BENCH json, and the
     # cost model's independent recount must agree within 5% — the
@@ -1255,6 +1420,13 @@ def main():
         print(
             f"[bench] FATAL: partition2d lane verdict failed: "
             f"{p2d_mismatch} — see the partition2d block above",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    if spgemm_mismatch is not None:
+        print(
+            f"[bench] FATAL: spgemm lane verdict failed: "
+            f"{spgemm_mismatch} — see the spgemm block above",
             file=sys.stderr,
         )
         sys.exit(2)

@@ -37,6 +37,8 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--bc_source", default="0")
     p.add_argument("--kcore_k", type=int, default=0)
     p.add_argument("--kclique_k", type=int, default=3)
+    p.add_argument("--cn_source", default="0",
+                   help="common_neighbors 2-hop query source vertex")
     p.add_argument("--pr_d", type=float, default=0.85)
     p.add_argument("--pr_mr", type=int, default=10)
     p.add_argument("--cdlp_mr", type=int, default=10)

@@ -38,6 +38,10 @@ from libgrape_lite_tpu.models.sssp_msg import BFSMsg, SSSPMsg
 from libgrape_lite_tpu.models.bfs_opt import BFSOpt
 from libgrape_lite_tpu.models.sssp_delta import SSSPDelta
 from libgrape_lite_tpu.models.lcc_beta import LCCBeta
+from libgrape_lite_tpu.models.triangle_count import (
+    CommonNeighbors,
+    TriangleCount,
+)
 from libgrape_lite_tpu.models.auto_apps import (
     BFSAuto,
     PageRankAuto,
@@ -114,4 +118,9 @@ APP_REGISTRY = {
     "sssp_vc": SSSPVC2D,
     "bfs_vc": BFSVC2D,
     "wcc_vc": WCCVC2D,
+    # r11 spgemm-backed workloads (ops/spgemm_pack.py, docs/SPGEMM.md):
+    # triangle counts share the LCC credit pass (both backends);
+    # common_neighbors is the serve-able 2-hop point query
+    "triangle_count": TriangleCount,
+    "common_neighbors": CommonNeighbors,
 }

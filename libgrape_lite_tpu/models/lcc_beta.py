@@ -78,6 +78,16 @@ class LCCBeta(ParallelAppBase):
 
         degree_threshold > 0 drops filtered (hub) vertices' lists — the
         reference's LCC cost cap (`lcc.h:234-243`, 0 = disabled)."""
+        from libgrape_lite_tpu.ops.spgemm_pack import resolve_lcc_backend
+
+        # GRAPE_LCC_BACKEND = spgemm/auto: the merge-intersection
+        # kernel has no spgemm lowering — RECORDED decline (never
+        # silent), results stay intersect-parity
+        resolve_lcc_backend(
+            type(self).__name__, frag, supported=False,
+            unsupported_reason="merge-intersection ELL kernel has no "
+            "spgemm lowering (use lcc_bitmap/lcc_opt)",
+        )
         self.degree_threshold = int(degree_threshold)
         fnum, vp = frag.fnum, frag.vp
         n_pad = fnum * vp

@@ -34,6 +34,16 @@ class LCCDirected(ParallelAppBase):
     result_format = "float"
 
     def init_state(self, frag, degree_threshold: int = 0, **_):
+        from libgrape_lite_tpu.ops.spgemm_pack import resolve_lcc_backend
+
+        # GRAPE_LCC_BACKEND = spgemm/auto: directed tricnt weighs
+        # reciprocal pairs twice — not the masked-SpGEMM credit
+        # algebra; RECORDED decline, results stay intersect-parity
+        resolve_lcc_backend(
+            type(self).__name__, frag, supported=False,
+            unsupported_reason="directed tricnt (direction-weighted "
+            "pairs) has no spgemm lowering",
+        )
         # hub cap like the undirected app; directed degree = out + in
         # with multiplicity (reference lcc.h:234-238)
         self.degree_threshold = int(degree_threshold)

@@ -1,0 +1,132 @@
+"""Triangle counting + 2-hop common-neighbor queries (r11).
+
+Workloads the tiled masked SpGEMM primitive (ops/spgemm_pack.py)
+opens beyond the six LDBC pulls — ROADMAP item 5a:
+
+  * `TriangleCount` — per-vertex T(v) and the global triangle count,
+    the GraphBLAS ``B = (A · Aᵀ) ∘ A`` formulation over the oriented
+    DAG.  It IS the LCC credit pass without the clustering-coefficient
+    ratio: the class subclasses LCC and swaps only the emit tail, so
+    both backends (GRAPE_LCC_BACKEND = intersect | spgemm | auto) and
+    the degree-threshold semantics come for free and per-vertex counts
+    are integer-identical to the LCC credits by construction.
+  * `CommonNeighbors` — the 2-hop point query cn(v) = |N(u) ∩ N(v)|
+    for a source u: two unit SpMV pulls of the one-hot source vector
+    (cn = A · (A · e_u), the masked-SpGEMM row the serve path asks for
+    one output row of).  Wired as a serve-able batched app via the
+    source-vector contract (`batch_query_key = "source"`), so the
+    admission queue coalesces k sources into one vmapped dispatch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from libgrape_lite_tpu.app.base import (
+    ParallelAppBase,
+    StepContext,
+    source_lane_array,
+)
+from libgrape_lite_tpu.models.lcc import LCC
+from libgrape_lite_tpu.utils.types import LoadStrategy, MessageStrategy
+
+
+class TriangleCount(LCC):
+    """Per-vertex triangle counts T(v); the global count T = Σ T(v)/3
+    lands in `self.global_triangles` at finalize (each triangle
+    credits its three corners exactly once — the invariant the
+    spgemm-vs-intersect tests pin)."""
+
+    result_format = "int"
+
+    def init_state(self, frag, degree_threshold: int = 0, **_):
+        state = super().init_state(
+            frag, degree_threshold=degree_threshold
+        )
+        state.pop("lcc")
+        state["tri"] = np.zeros((frag.fnum, frag.vp), dtype=np.int32)
+        return state
+
+    def _emit(self, ctx: StepContext, frag, state, tri):
+        out = jnp.where(frag.inner_mask, tri, 0).astype(jnp.int32)
+        return dict(state, tri=out), jnp.int32(0)
+
+    def invariants(self, frag, state):
+        from libgrape_lite_tpu.guard.invariants import in_range
+
+        # a triangle count is a non-negative cardinality
+        return [in_range("tri", lo=0)]
+
+    def finalize(self, frag, state):
+        vals = np.asarray(state["tri"]).astype(np.int64)
+        inner = np.zeros_like(vals)
+        for f in range(frag.fnum):
+            n = frag.inner_vertices_num(f)
+            inner[f, :n] = vals[f, :n]
+        self.global_triangles = int(inner.sum() // 3)
+        return vals
+
+
+_NO_SOURCE = -1
+
+
+class CommonNeighbors(ParallelAppBase):
+    """cn(v) = |N(u) ∩ N(v)| for a query source u — two pull rounds of
+    the one-hot source vector over the (deduplicated) out-adjacency;
+    the source's own row is zeroed (cn(u, u) is a degree, not a
+    common-neighbor count).  Multiplicities are deduplicated like the
+    LCC family: cn counts NEIGHBORS, not parallel edges."""
+
+    load_strategy = LoadStrategy.kOnlyOut
+    message_strategy = MessageStrategy.kSyncOnOuterVertex
+    result_format = "int"
+    batch_query_key = "source"   # serve/: [k]-source batched dispatch
+    replicated_keys = frozenset({"hop"})
+    max_rounds = 8  # 2 pull rounds; the vote terminates after hop 2
+
+    def init_state(self, frag, source=_NO_SOURCE, **_):
+        batched, seed = source_lane_array(
+            frag, source, "CommonNeighbors", 0, 1, np.int32
+        )
+        k = seed.shape[0]
+        state = {
+            "cn": seed.copy() if batched else seed[0].copy(),
+            "seed": seed if batched else seed[0],
+            "hop": (np.zeros((k,), np.int32) if batched
+                    else np.int32(0)),
+        }
+        return state
+
+    def peval(self, ctx: StepContext, frag, state):
+        return state, jnp.int32(1)
+
+    def inceval(self, ctx: StepContext, frag, state):
+        oe = frag.oe
+        vp = frag.vp
+        full = ctx.gather_state(state["cn"])
+        # the LCC family's adjacent-duplicate rule, shared — cn counts
+        # NEIGHBORS, not parallel edges
+        vals = jnp.where(
+            LCC._dedup_mask(oe), full[oe.edge_nbr], 0
+        ).astype(jnp.int32)
+        pulled = self.segment_reduce(vals, oe.edge_src, vp, "sum")
+        hop = state["hop"] + 1
+        done = hop >= 2
+        # the final hop zeroes the source row and masks padding
+        cn = jnp.where(
+            jnp.logical_and(frag.inner_mask, state["seed"] == 0),
+            pulled, 0,
+        )
+        cn = jnp.where(done, cn, pulled).astype(jnp.int32)
+        active = jnp.where(done, jnp.int32(0), jnp.int32(1))
+        return dict(state, cn=cn, hop=hop), active
+
+    def invariants(self, frag, state):
+        from libgrape_lite_tpu.guard.invariants import in_range
+
+        return [in_range("cn", lo=0)]
+
+    def finalize(self, frag, state):
+        return np.asarray(state["cn"]).astype(np.int64)

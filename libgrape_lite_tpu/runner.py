@@ -36,6 +36,7 @@ class QueryArgs:
     bc_source: int | str = 0
     kcore_k: int = 0
     kclique_k: int = 3
+    cn_source: int | str = 0  # common_neighbors 2-hop query source
     pr_d: float = 0.85
     pr_mr: int = 10
     cdlp_mr: int = 10
@@ -85,10 +86,13 @@ def build_query_kwargs(app_name: str, args: QueryArgs) -> dict:
         return {"k": args.kclique_k}
     if app_name.startswith("pagerank"):
         return {"delta": args.pr_d, "max_round": args.pr_mr}
-    if app_name.startswith("lcc"):
+    if app_name.startswith("lcc") or app_name == "triangle_count":
         # hub cost cap (reference FLAGS_degree_threshold, lcc.h:234-243);
-        # 0 = disabled (the reference's INT_MAX default)
+        # 0 = disabled (the reference's INT_MAX default);
+        # triangle_count shares the LCC credit pass and its filter
         return {"degree_threshold": args.degree_threshold}
+    if app_name == "common_neighbors":
+        return {"source": _coerce_source(args.cn_source, args.string_id)}
     if app_name.startswith("cdlp"):
         return {"max_round": args.cdlp_mr}
     return {}

@@ -1934,9 +1934,11 @@ class Worker:
         # the pipelined round dispatches the split sub-plans instead
         # of the full plan, but the split partitions the edge set, so
         # the full plan's ledger below remains the honest per-round
-        # bill either way
+        # bill either way.  `_spgemm` (r11, ops/spgemm_pack.py) ships
+        # the same split-column ledger shape, so the masked-SpGEMM
+        # backend's bill surfaces through the identical path
         ledgers = []
-        for attr in ("_pack", "_pack_ie", "_pack_oe"):
+        for attr in ("_pack", "_pack_ie", "_pack_oe", "_spgemm"):
             d = getattr(self.app, attr, None)
             if d is not None and callable(getattr(d, "ledger", None)):
                 led = d.ledger()

@@ -68,6 +68,19 @@ echo "pagerank --directed"; run 4 pagerank --pr_mr=10 --directed; verify eps p2p
 echo "== lcc (fnum=4) =="
 run 4 lcc; verify eps p2p-31-LCC
 
+echo "== lcc backend A/B: spgemm cmp-identical to intersect (fnum=4) =="
+# GRAPE_LCC_BACKEND=spgemm routes the bitmap LCC's triangle credits
+# through the tiled masked SpGEMM (ops/spgemm_pack.py); the credit
+# algebra is integer-identical, so the merged result files must be
+# bit-identical to the intersect run's (docs/SPGEMM.md)
+( export GRAPE_LCC_BACKEND=intersect; run 4 lcc_opt )
+cp "$OUT/merged.res" "$OUT/lcc_intersect.res"
+( export GRAPE_LCC_BACKEND=spgemm; run 4 lcc_opt )
+cmp "$OUT/lcc_intersect.res" "$OUT/merged.res" \
+  || { echo "SPGEMM LCC DIVERGED FROM INTERSECT" >&2; exit 1; }
+verify eps p2p-31-LCC
+echo "  OK (byte-identical across backends)"
+
 echo "== vertex-cut pagerank (fnum=4) =="
 run 4 pagerank --vc --pr_mr=10; verify eps p2p-31-PR
 

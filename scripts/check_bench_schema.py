@@ -44,6 +44,7 @@ _TOP = {
     "dyn": (dict, False),
     "pipeline": (dict, False),
     "partition2d": (dict, False),
+    "spgemm": (dict, False),
 }
 
 _SSSP = {
@@ -178,6 +179,35 @@ _PARTITION2D = {
     "tile_recount_mismatch": (_NUM, True),
 }
 
+# the r11 masked-SpGEMM lane (ops/spgemm_pack.py, docs/SPGEMM.md):
+# LCC intersect-vs-spgemm wall A/B at the lane geometry with the
+# bit-exactness verdict and the shipped-plan ledger recount (the 5%
+# gate), plus the modeled ops/edge A/B at full bench geometry —
+# spgemm MXU elems + VPU lanes per oriented mask edge against the
+# popcount sweep's word-ops, priced into modeled seconds with the
+# win verdict and the ledger-auto decision.  Verdict fields are
+# DECLARED bool, like the pipeline lane's.
+_SPGEMM = {
+    "scale": (int, True),
+    "bench_scale": (int, True),
+    "intersect_s": (_NUM, True),
+    "spgemm_s": (_NUM, True),
+    "byte_identical": (bool, True),
+    "items": (int, True),
+    "items_per_edge": (_NUM, True),
+    "mask_edges": (int, True),
+    "ledger_recount_mismatch": (_NUM, True),
+    "bench_mask_edges": (int, True),
+    "bench_items_per_edge": (_NUM, True),
+    "mxu_elems_per_edge": (_NUM, True),
+    "vpu_ops_per_edge": (_NUM, True),
+    "intersect_word_ops_per_edge": (_NUM, True),
+    "modeled_spgemm_s": (_NUM, True),
+    "modeled_intersect_s": (_NUM, True),
+    "modeled_win": (bool, True),
+    "auto_backend": (str, True),
+}
+
 _SPAN_ROLLUP = {
     "count": (int, True),
     "total_s": (_NUM, True),
@@ -195,6 +225,7 @@ SCHEMA = {
     "dyn": _DYN,
     "pipeline": _PIPELINE,
     "partition2d": _PARTITION2D,
+    "spgemm": _SPGEMM,
 }
 
 
@@ -239,7 +270,8 @@ def validate_record(record) -> list:
                       ("pack_ledger", _PACK_LEDGER), ("obs", _OBS),
                       ("serve", _SERVE), ("dyn", _DYN),
                       ("pipeline", _PIPELINE),
-                      ("partition2d", _PARTITION2D)):
+                      ("partition2d", _PARTITION2D),
+                      ("spgemm", _SPGEMM)):
         block = record.get(key)
         if isinstance(block, dict):
             _check_block(block, spec, key, errors)
@@ -266,6 +298,13 @@ def validate_record(record) -> list:
                     f"partition2d.{f}: {p2.get(f)!r} not in "
                     "('1d', '2d')"
                 )
+    sg = record.get("spgemm")
+    if isinstance(sg, dict):
+        if sg.get("auto_backend") not in (None, "intersect", "spgemm"):
+            errors.append(
+                f"spgemm.auto_backend: {sg.get('auto_backend')!r} not "
+                "in ('intersect', 'spgemm')"
+            )
     ob = record.get("obs")
     if isinstance(ob, dict) and isinstance(ob.get("spans"), dict):
         for name, r in ob["spans"].items():
@@ -359,7 +398,7 @@ def main(argv=None) -> int:
             else:
                 blocks = [k for k in ("sssp", "guard", "pack_ledger",
                                       "obs", "serve", "dyn", "pipeline",
-                                      "partition2d")
+                                      "partition2d", "spgemm")
                           if k in record]
                 print(f"OK {label} ({record.get('metric')}"
                       + (f"; blocks: {', '.join(blocks)}" if blocks

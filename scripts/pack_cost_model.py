@@ -257,6 +257,63 @@ def tile_plan_recount(mplan) -> dict:
     }
 
 
+def spgemm_recount(plan) -> dict:
+    """The r11 masked-SpGEMM gate (bench `spgemm` lane): the plan's
+    op-budget ledger vs an independent recount from the SHIPPED device
+    streams.  The real item count is decoded from the `valid` planes
+    (never from `plan.items` — that is a planner annotation), the
+    per-item plane costs are HARDCODED here as the independent
+    codification of the documented conventions (importing
+    spgemm_pack's constants would make the gate tautological: 10 VPU
+    planes of 128 lanes, one 128-elem MXU count-reduce row and two
+    bitmap row fetches per item), and HBM bytes come from the actual
+    array sizes.  Mismatch gated at MISMATCH_TOLERANCE by bench.py
+    exactly like the SpMV op-budget ledger."""
+    st = plan.host_streams
+    if st is None:
+        return {"spgemm_recount_mismatch": 1.0,
+                "reason": "plan_only plan ships no streams"}
+    valid = np.asarray(st["valid"]).astype(np.int64)
+    items = int(valid.sum())
+    # consistency decode: every valid item's rows/tile must be
+    # addressable in the shipped sub-bitmap — corrupt streams must
+    # fail loudly, not price as zero
+    bm = np.asarray(st["bm"])
+    kt = np.asarray(st["kt"])
+    for f in range(valid.shape[0]):
+        sel = valid[f] > 0
+        if not sel.any():
+            continue
+        assert int(np.asarray(st["vrow"])[f, sel].max()) < bm.shape[1], \
+            "spgemm item references a row beyond the shipped bitmap"
+        assert int(kt[f, sel].max()) * 4 < bm.shape[2], \
+            "spgemm item references a K-tile beyond the shipped bitmap"
+    rec = {
+        "vpu_ops": 10 * 128 * items,
+        "mxu_ops": 128 * items,
+        "gather_rows": 2 * items,
+        "hbm_bytes": sum(int(np.asarray(a).nbytes) for a in st.values()),
+    }
+    totals = (plan.ledger or {}).get("totals")
+    if not totals:
+        return {"spgemm_recount_mismatch": 1.0,
+                "reason": "plan ships no ledger"}
+    mismatch = max(
+        abs(totals[k] - rec[k]) / max(1, totals[k])
+        for k in ("vpu_ops", "mxu_ops", "hbm_bytes")
+    )
+    return {
+        "spgemm_recount_mismatch": round(mismatch, 4),
+        "items_recounted": items,
+        "ledger_vpu_ops": totals["vpu_ops"],
+        "recount_vpu_ops": rec["vpu_ops"],
+        "ledger_mxu_ops": totals["mxu_ops"],
+        "recount_mxu_ops": rec["mxu_ops"],
+        "ledger_hbm_bytes": totals["hbm_bytes"],
+        "recount_hbm_bytes": rec["hbm_bytes"],
+    }
+
+
 def price(totals: dict, edges: int) -> dict:
     """Wall-clock + MTEPS bracket from ledger totals under the explicit
     v5e rates; the gather rate is bracketed (the probe's unknown).

@@ -66,6 +66,19 @@ GRAPE_BENCH_ASSUME_ALIVE=1 GRAPE_PIPELINE=1 timeout 3600 python bench.py \
 grep -h "\[bench\] pipeline" "$OUT/bench_pipe0.err" \
   "$OUT/bench_pipe1.err" | tail -4 || true
 
+echo "== lcc backend A/B (GRAPE_LCC_BACKEND=intersect vs spgemm —
+tiled masked SpGEMM on the MXU, ops/spgemm_pack.py; the bench's own
+spgemm lane runs the pair at lane geometry and gates on bit-identity
++ the ledger recount; docs/SPGEMM.md) =="
+GRAPE_BENCH_ASSUME_ALIVE=1 GRAPE_LCC_BACKEND=intersect \
+  timeout 3600 python bench.py \
+  2> "$OUT/bench_lcc_int.err" | tee "$OUT/bench_lcc_int.json" || true
+GRAPE_BENCH_ASSUME_ALIVE=1 GRAPE_LCC_BACKEND=spgemm \
+  timeout 3600 python bench.py \
+  2> "$OUT/bench_lcc_sp.err" | tee "$OUT/bench_lcc_sp.json" || true
+grep -h "\[bench\] spgemm" "$OUT/bench_lcc_int.err" \
+  "$OUT/bench_lcc_sp.err" | tail -4 || true
+
 echo "== per-stage profile (stepwise mode, per-round wall clock) =="
 GRAPE_SPMV=pack GRAPE_TPU_VLOG=1 timeout 1200 python - <<'EOF' 2>&1 | tee "$OUT/profile.log" || true
 import sys
