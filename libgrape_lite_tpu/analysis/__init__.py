@@ -1,11 +1,11 @@
 """grape-lint: static contract linter + compiled-artifact auditor.
 
 The compile-time complement to guard/ (which proves invariants at
-runtime): Layer 1 AST lints (R1-R6, analysis/astlint.py) make the bug
+runtime): Layer 1 AST lints (R1-R7, analysis/astlint.py) make the bug
 classes earlier review passes caught by hand un-shippable — baked
 closure constants, per-dispatch re-jits, incomplete cache keys, query
 entrypoints that skip the dyn stale-view check, eager hot-loop
-logging; Layer 2 artifact audits (A1-A3, analysis/artifact.py)
+logging, host syncs on the async pump's dispatch stage; Layer 2 artifact audits (A1-A3, analysis/artifact.py)
 recount the same contracts from the actually-lowered/compiled runners
 and the live XLA compile stream.  Intentional exceptions are named in
 analysis/baseline.json, never invisible.

@@ -1,4 +1,4 @@
-"""Layer 1 of grape-lint: AST checks R1-R6 over the library source.
+"""Layer 1 of grape-lint: AST checks R1-R7 over the library source.
 
 Each checker's docstring names the historical, actually-shipped bug it
 fossilizes (see analysis/rules.py for the catalogue and CHANGES.md for
@@ -840,12 +840,128 @@ def _check_r6(module: _Scope, path: str, findings: List[Finding]) -> None:
 
 
 # ---------------------------------------------------------------------------
+# R7 — host syncs on the async pump's dispatch stage
+# ---------------------------------------------------------------------------
+
+_R7_PATH_RE = re.compile(r"(^|/)serve/pipeline\.py$")
+_R7_DISPATCH_RE = re.compile(r"^_?(dispatch|fill)")
+
+
+def _pump_harvest_contract():
+    """The audited harvest contract: the pump module's own declaration
+    of which methods may force a host sync.  Imported from the runtime
+    module (like R6's window contract) so the lint judges fixtures and
+    the shipped tree against one set."""
+    try:
+        from libgrape_lite_tpu.serve.pipeline import PUMP_HARVEST_SYNCS
+    except Exception:  # pragma: no cover — partial checkouts
+        return frozenset()
+    return frozenset(PUMP_HARVEST_SYNCS)
+
+
+def _r7_sync_forcer(call: ast.Call) -> Optional[str]:
+    """A human-readable tag when `call` forces a host sync, else None:
+    block_until_ready / device_get, np/jnp.asarray (materialises the
+    device buffer), .item()/.tolist(), and the builtins int()/float()
+    on a non-literal argument (converting a device scalar blocks on
+    it)."""
+    base = _callee_base(call.func)
+    if base in ("block_until_ready", "device_get"):
+        return f"{base}()"
+    if (
+        base == "asarray"
+        and isinstance(call.func, ast.Attribute)
+        and _root_name(call.func) in _ARRAY_MODULES
+    ):
+        return "asarray() (materialises the device buffer)"
+    if (
+        isinstance(call.func, ast.Name)
+        and base in ("int", "float")
+        and call.args
+        and not isinstance(call.args[0], ast.Constant)
+    ):
+        return f"{base}() on a non-literal value"
+    if base in ("item", "tolist") and isinstance(call.func, ast.Attribute):
+        return f".{base}()"
+    return None
+
+
+def _check_r7(module: _Scope, path: str, findings: List[Finding]) -> None:
+    """R7 sync-in-pump.  The async serve pump's dispatch stage
+    (serve/pipeline.py `_fill*`/`_dispatch*` self-call chains) exists
+    to keep a window of batches in flight; a single host-sync forcer
+    on that path silently re-serialises the whole window — the exact
+    defect class the pump replaced (the synchronous loop blocked
+    pulling every lane's result before the next batch could
+    dispatch).  The harvest stage is WHERE syncs belong, and the pump
+    module names its harvest-side methods in `PUMP_HARVEST_SYNCS`;
+    this rule walks every self-call chain rooted at a dispatch-stage
+    method, stops at contract names, and flags any sync forcer it
+    reaches.  Nested functions are skipped: a deferred thunk built at
+    dispatch time runs at harvest time.  Path-scoped to
+    serve/pipeline.py — the synchronous session/queue loop is ALLOWED
+    to sync; only the pump's dispatch stage carries the contract."""
+    if not _R7_PATH_RE.search(path):
+        return
+    contract = _pump_harvest_contract()
+
+    def scan(fs: _Scope, owner: str) -> None:
+        for n in _shallow(fs.node):
+            if isinstance(n, ast.Call):
+                what = _r7_sync_forcer(n)
+                if what is not None:
+                    findings.append(Finding(
+                        "R7", path, n.lineno, owner,
+                        f"{what} reached from the pump's dispatch "
+                        "stage outside the audited harvest contract "
+                        "(serve/pipeline.PUMP_HARVEST_SYNCS) — one "
+                        "stray sync re-serialises the dispatch "
+                        "window; move it to the harvest stage or "
+                        "audit and name the method in the contract",
+                    ))
+
+    for s in _all_scopes(module):
+        if s.kind == "class" and isinstance(s.node, ast.ClassDef):
+            facts = _method_facts(s.node)
+            roots = [
+                m for m in facts
+                if _R7_DISPATCH_RE.match(m) and m not in contract
+            ]
+            if not roots:
+                continue
+            seen: Set[str] = set()
+            stack = list(roots)
+            while stack:
+                m = stack.pop()
+                if m in seen or m in contract or m not in facts:
+                    continue
+                seen.add(m)
+                _, calls, _ = facts[m]
+                stack.extend(c for c in calls if c not in contract)
+            scopes = {
+                c.name: c for c in s.children if c.kind == "function"
+            }
+            for name in sorted(seen):
+                fs = scopes.get(name)
+                if fs is not None:
+                    scan(fs, f"{s.name}.{name}")
+        elif (
+            s.kind == "function"
+            and s.parent is not None
+            and s.parent.kind == "module"
+            and _R7_DISPATCH_RE.match(s.name)
+            and s.name not in contract
+        ):
+            scan(s, s.qualname)
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
 
 def lint_source(src: str, relpath: str) -> List[Finding]:
-    """All R1-R6 findings for one module's source text."""
+    """All R1-R7 findings for one module's source text."""
     relpath = relpath.replace(os.sep, "/")
     try:
         tree = ast.parse(src)
@@ -868,6 +984,7 @@ def lint_source(src: str, relpath: str) -> List[Finding]:
     _check_r4(module, relpath, findings)
     _check_r5(module, relpath, findings)
     _check_r6(module, relpath, findings)
+    _check_r7(module, relpath, findings)
     return findings
 
 

@@ -28,12 +28,13 @@ from typing import Dict, List, Optional
 # severity is advisory (every unsuppressed finding fails the gate);
 # it orders the human report so the compile-visible classes lead
 _SEVERITY = {"R1": 0, "R2": 1, "R3": 2, "R4": 3, "R5": 4, "R6": 3,
+             "R7": 1,
              "A1": 0, "A2": 1, "A3": 1}
 
 
 @dataclass(frozen=True)
 class Finding:
-    rule: str          # "R1".."R6" (AST) / "A1".."A3" (artifact)
+    rule: str          # "R1".."R7" (AST) / "A1".."A3" (artifact)
     path: str          # repo-relative, '/'-separated
     line: int          # 1-indexed; 0 for artifact-level findings
     symbol: str        # enclosing qualname ("Worker._make_runner.stepper")

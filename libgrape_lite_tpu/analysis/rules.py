@@ -100,6 +100,20 @@ RULES: Dict[str, Rule] = {
             "aliasing class is un-shippable instead of re-findable",
         ),
         Rule(
+            "R7", "sync-in-pump",
+            "a host-sync forcer (block_until_ready, jax.device_get, "
+            "np/jnp.asarray, or int()/float() on a non-literal value) "
+            "is reached from serve/pipeline.py dispatch-stage code "
+            "(_dispatch*/_fill* self-call chains) outside the audited "
+            "harvest contract (serve/pipeline.PUMP_HARVEST_SYNCS) — "
+            "one stray sync re-serialises the whole dispatch window",
+            "PR 12 (preventive): the synchronous serve loop blocked "
+            "pulling every lane's result to host before the next "
+            "batch could dispatch — the exact defect class the async "
+            "pump removes; fossilized so it cannot creep back into "
+            "the dispatch stage (zero-entry baseline)",
+        ),
+        Rule(
             "A1", "constant-bloat",
             "the lowered HLO of a fused runner holds a literal "
             "constant above the byte threshold — an R1 escape "

@@ -10,7 +10,7 @@ vmapped multi-source batching, and print one JSON summary line
 (queries, qps, p50/p99 latency, batch-size histogram).
 
 `python -m libgrape_lite_tpu.cli lint ...` runs grape-lint
-(analysis/, docs/STATIC_ANALYSIS.md): the AST contract rules R1-R5
+(analysis/, docs/STATIC_ANALYSIS.md): the AST contract rules R1-R7
 over the library tree (or explicit paths), optionally the
 compiled-artifact audits (--artifact), against the suppression
 baseline — exits nonzero on any unsuppressed finding.
@@ -119,6 +119,20 @@ def make_serve_parser() -> argparse.ArgumentParser:
                    help="lanes per vmapped dispatch (serve/policy.py)")
     p.add_argument("--max_wait_ms", type=float, default=0.0,
                    help="queue-head wait before a partial batch ships")
+    p.add_argument("--inflight", type=int, default=1,
+                   help="dispatch-window depth (serve/pipeline.py): "
+                        ">1 arms the async pump — up to W coalesced "
+                        "batches dispatched un-synced with lazy FIFO "
+                        "harvest, ingest as a window barrier; 1 "
+                        "(default) keeps the synchronous loop "
+                        "bit-for-bit (GRAPE_SERVE_INFLIGHT overrides "
+                        "a pump's depth, recorded in PUMP_STATS)")
+    p.add_argument("--dump_results", default="",
+                   help="write one line per query in submit order "
+                        "(index, app, ok, rounds, sha256 of the "
+                        "assembled values) — the identity surface the "
+                        "async smoke cmp's between --inflight 1 and "
+                        "--inflight 4 runs")
     p.add_argument("--max_rounds", type=int, default=0)
     p.add_argument("--guard", default="",
                    choices=["", "off", "warn", "halt", "rollback"],
@@ -350,31 +364,57 @@ def serve_main(argv=None):
         guard=ns.guard or None,
         dyn=dyn,
     )
+    # --inflight > 1 arms the async pump (serve/pipeline.py): up to W
+    # coalesced batches dispatched un-synced, lazy FIFO harvest, and
+    # every ingest an explicit window barrier.  --inflight 1 keeps the
+    # synchronous loop below bit-for-bit.
+    pump = sess.async_pump(window=ns.inflight) if ns.inflight > 1 else None
     t0 = time.perf_counter()
-    for app_key, src in queries:
+    reqs = [
         sess.submit(app_key, {"source": src},
                     max_rounds=ns.max_rounds or None)
+        for app_key, src in queries
+    ]
     if delta_ops:
         # streaming mode: ingest a delta chunk after every
-        # --ingest_every pumped queries, so updates land between
-        # batches while the query stream stays live (the host-pumped
-        # loop makes each ingest a consistent superstep boundary)
+        # --ingest_every dispatched queries, so updates land between
+        # batches while the query stream stays live.  The sync loop
+        # makes each ingest a superstep boundary by construction; the
+        # async pump makes it an explicit window quiesce — and pins
+        # the SAME ingest points by dispatch count (`max_dispatch`),
+        # so the batch <-> graph-version interleave (and therefore
+        # every result byte) is identical at any --inflight.
         ingest_every = max(1, ns.ingest_every)
         n_chunks = max(1, -(-len(queries) // ingest_every))
         chunk = -(-len(delta_ops) // n_chunks)
         oi = 0
         results = []
-        while sess.queue.pending() or oi < len(delta_ops):
-            pumped = 0
-            while sess.queue.pending() and pumped < ingest_every:
-                got = sess.pump(force=True)
-                results.extend(got)
-                pumped += len(got)
-            if oi < len(delta_ops):
-                sess.ingest(delta_ops[oi:oi + chunk])
-                oi += chunk
+        if pump is not None:
+            while (sess.queue.pending() or pump.inflight()
+                   or oi < len(delta_ops)):
+                target = pump.dispatched_queries + ingest_every
+                while (sess.queue.pending()
+                       and pump.dispatched_queries < target):
+                    pump.pump(force=True, block=True,
+                              max_dispatch=target)
+                if oi < len(delta_ops):
+                    pump.ingest(delta_ops[oi:oi + chunk])
+                    oi += chunk
+                else:
+                    pump.drain()
+            results = [q.result for q in reqs]
+        else:
+            while sess.queue.pending() or oi < len(delta_ops):
+                pumped = 0
+                while sess.queue.pending() and pumped < ingest_every:
+                    got = sess.pump(force=True)
+                    results.extend(got)
+                    pumped += len(got)
+                if oi < len(delta_ops):
+                    sess.ingest(delta_ops[oi:oi + chunk])
+                    oi += chunk
     else:
-        results = sess.drain()
+        results = pump.drain() if pump is not None else sess.drain()
     wall = time.perf_counter() - t0
 
     lat = sorted(r.latency_s for r in results)
@@ -382,6 +422,7 @@ def serve_main(argv=None):
     per_app: dict = {}
     for r in results:
         per_app[r.app_key] = per_app.get(r.app_key, 0) + 1
+    wait_summary = sess.queue.admission_wait_summary()
     record = {
         "queries": len(results),
         "ok": ok,
@@ -392,12 +433,26 @@ def serve_main(argv=None):
         "p99_ms": round(
             1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
         "max_batch": ns.max_batch,
+        "inflight": ns.inflight,
         "batch_hist": {
             str(k): v for k, v in sorted(sess.queue.batch_hist.items())
+        },
+        # per-request submit->dispatch wait (serve/queue.py): the
+        # admission-latency half of the p99 story, next to batch_hist
+        "admission_wait_ms": {
+            "p50": wait_summary["p50_ms"], "p99": wait_summary["p99_ms"],
         },
         "apps": per_app,
         "cache": sess.cache_stats(),
     }
+    if pump is not None:
+        from libgrape_lite_tpu.serve import PUMP_STATS
+
+        record["pump"] = {
+            "window": pump.window,
+            **pump.stats,
+            **PUMP_STATS.snapshot(),
+        }
     if delta_ops:
         # the same field names as bench.py's schema-checked dyn block
         # (scripts/check_bench_schema.py _DYN), so both surfaces
@@ -413,6 +468,25 @@ def serve_main(argv=None):
                 if wall > 0 else 0.0
             ),
         }
+    if ns.dump_results:
+        # submit-order identity surface: one line per query with a
+        # digest of its assembled values — byte-comparable across
+        # --inflight settings (the async smoke cmp's 4 against 1)
+        import hashlib
+
+        with open(ns.dump_results, "w") as fh:
+            for i, req in enumerate(reqs):
+                r = req.result
+                digest = (
+                    hashlib.sha256(r.values.tobytes()).hexdigest()
+                    if r is not None and r.ok and r.values is not None
+                    else "-"
+                )
+                ok_flag = int(bool(r is not None and r.ok))
+                rounds = r.rounds if r is not None else -1
+                fh.write(
+                    f"{i} {req.app_key} {ok_flag} {rounds} {digest}\n"
+                )
     print(json.dumps(record), flush=True)
     if results and not ok:
         print("[serve] every query failed", file=sys.stderr)

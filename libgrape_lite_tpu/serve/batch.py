@@ -17,6 +17,16 @@ halt the dispatch it shares.
 Rollback policy degrades to per-lane halt here: batched queries have
 no per-lane checkpoint lineage (the monitor logs the downgrade, as the
 unchunked guarded path did before PR 6 grew snapshots).
+
+Under the async pump (serve/pipeline.py) a guarded batch still runs
+this chunk loop at dispatch time — breach isolation needs the probe
+verdicts, which sync at every chunk boundary by design — but the
+verdict arrays are snapshot into a `BatchDispatch` handle and the
+per-lane VALUES harvest lazily with everyone else's, so a guarded
+batch mid-window never blocks on value extraction and batches behind
+it in the window keep executing while the chunk loop probes.  Breach
+semantics are pinned unchanged either way (tests/test_serve_async.py
+poisons a lane with W>1 batches in flight).
 """
 
 from __future__ import annotations

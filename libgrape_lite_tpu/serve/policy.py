@@ -33,6 +33,14 @@ class BatchPolicy:
     # has coalesced by the time the pump runs (scripted/offline streams
     # drain as fast as possible)
     max_wait_s: float = 0.0
+    # dispatch-window depth for the async pump (serve/pipeline.py):
+    # how many coalesced batches may be dispatched-but-unharvested at
+    # once.  1 = the synchronous discipline (dispatch, harvest, then
+    # pick the next batch — result-order- and byte-identical to the
+    # host-pumped loop); >1 overlaps host admission/extraction with
+    # device execution via JAX async dispatch.  Only consulted when a
+    # pump is constructed — the plain queue.pump path never reads it.
+    inflight: int = 1
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -42,6 +50,10 @@ class BatchPolicy:
         if self.max_wait_s < 0:
             raise ValueError(
                 f"max_wait_s must be >= 0, got {self.max_wait_s}"
+            )
+        if self.inflight < 1:
+            raise ValueError(
+                f"inflight must be >= 1, got {self.inflight}"
             )
 
 

@@ -11,6 +11,14 @@ compatible requests in arrival order (requests BETWEEN them stay
 queued — admission never reorders within a class, and an incompatible
 head never blocks forever because `drain`/timeout forces partial
 batches).
+
+The pop/dispatch/deliver split (`_pop_ready` / the dispatch callback /
+`deliver`) exists for the async pump (serve/pipeline.py): the pump
+pops ready batches with the SAME policy decision this module's own
+`pump` uses, keeps up to W of them dispatched-but-unharvested, and
+delivers through the same bookkeeping — so batch composition, FIFO
+order, the batch-size histogram, and the admission-wait record are
+one implementation regardless of how many batches are in flight.
 """
 
 from __future__ import annotations
@@ -45,22 +53,63 @@ class QueryRequest:
         return self.result is not None
 
 
-@dataclass
 class ServeResult:
     """Per-query outcome: either assembled values or a structured
     error (a guard breach bundle for poisoned lanes — batchmates of a
-    breached query complete normally, serve/batch.py isolates lanes)."""
+    breached query complete normally, serve/batch.py isolates lanes).
 
-    request_id: int
-    app_key: str
-    ok: bool
-    values: Optional[np.ndarray] = None  # [fnum, vp] assembled
-    rounds: int = 0
-    terminate_code: int = 0
-    error: Optional[dict] = None  # breach bundle / failure detail
-    lane: int = 0  # position inside the dispatched batch
-    batch_size: int = 1
-    latency_s: float = 0.0  # submit -> result delivery
+    `values` has a DEFERRED form for the async pump
+    (serve/pipeline.py): constructed with `values_fn` instead of
+    `values`, the [fnum, vp] assembly (device sync + finalize) runs
+    the first time `values` is read — or when the harvest stage drains
+    the batch, whichever comes first — so host-side extraction of
+    batch N-1 overlaps device execution of batch N.  Synchronous
+    construction with `values=` is unchanged, and a resolved result is
+    indistinguishable from an eager one."""
+
+    __slots__ = ("request_id", "app_key", "ok", "rounds",
+                 "terminate_code", "error", "lane", "batch_size",
+                 "latency_s", "_values", "_values_fn")
+
+    def __init__(self, request_id: int, app_key: str, ok: bool,
+                 values: Optional[np.ndarray] = None, rounds: int = 0,
+                 terminate_code: int = 0, error: Optional[dict] = None,
+                 lane: int = 0, batch_size: int = 1,
+                 latency_s: float = 0.0,
+                 values_fn: Optional[Callable[[], np.ndarray]] = None):
+        self.request_id = request_id
+        self.app_key = app_key
+        self.ok = ok
+        self.rounds = rounds
+        self.terminate_code = terminate_code
+        self.error = error  # breach bundle / failure detail
+        self.lane = lane  # position inside the dispatched batch
+        self.batch_size = batch_size
+        self.latency_s = latency_s  # submit -> result delivery
+        self._values = values  # [fnum, vp] assembled
+        self._values_fn = values_fn
+
+    @property
+    def values(self) -> Optional[np.ndarray]:
+        if self._values is None and self._values_fn is not None:
+            fn, self._values_fn = self._values_fn, None
+            self._values = fn()
+        return self._values
+
+    @values.setter
+    def values(self, v) -> None:
+        self._values = v
+        self._values_fn = None
+
+    @property
+    def deferred(self) -> bool:
+        """True while the values are still an un-synced thunk."""
+        return self._values_fn is not None
+
+    def resolve(self) -> "ServeResult":
+        """Force the deferred values now (the harvest stage's drain)."""
+        self.values
+        return self
 
 
 class AdmissionQueue:
@@ -83,6 +132,12 @@ class AdmissionQueue:
         self._pending: List[QueryRequest] = []
         self.batch_hist: Dict[int, int] = {}
         self.completed = 0
+        # per-request submit->dispatch wait (seconds), recorded at pop
+        # time next to the batch-size histogram: the admission-latency
+        # half of the serving story (the histogram says how well the
+        # stream coalesced; this says what the coalescing COST each
+        # request at the head of the queue)
+        self.admission_waits: List[float] = []
 
     def submit(self, app_key: str, args: dict | None = None, *,
                max_rounds: int | None = None,
@@ -110,11 +165,13 @@ class AdmissionQueue:
                 batch.append(req)
         return batch
 
-    def pump(self, now: float | None = None, *,
-             force: bool = False) -> List[ServeResult]:
-        """Dispatch at most ONE batch: when it is full, when the head
-        request has waited `max_wait_s`, or when `force`d (drain).
-        Returns the delivered results ([] = nothing was ready)."""
+    def _pop_ready(self, now: float | None = None, *,
+                   force: bool = False) -> List[QueryRequest]:
+        """Pop at most ONE ready batch off the queue — the policy
+        decision shared by the synchronous `pump` and the async pump's
+        dispatch stage (serve/pipeline.py).  Ready = full, head waited
+        `max_wait_s`, or `force`d.  Records each popped request's
+        submit->dispatch wait.  [] = nothing ready."""
         if not self._pending:
             return []
         batch = self._head_batch()
@@ -125,7 +182,26 @@ class AdmissionQueue:
                 return []
         ids = {r.id for r in batch}
         self._pending = [r for r in self._pending if r.id not in ids]
-        results = self._dispatch(batch)
+        t_pop = time.perf_counter()
+        from libgrape_lite_tpu import obs
+
+        hist = obs.metrics().histogram(
+            "grape_serve_admission_wait_seconds",
+            help="per-request submit->dispatch wait in the "
+                 "admission queue",
+        )
+        for req in batch:
+            wait = t_pop - req.submitted_s
+            self.admission_waits.append(wait)
+            hist.observe(wait)
+        return batch
+
+    def deliver(self, batch: List[QueryRequest],
+                results: List[ServeResult]) -> List[ServeResult]:
+        """Bind one dispatched batch's results to its requests
+        (latency stamping, histogram/completion bookkeeping) — shared
+        by the synchronous `pump` and the async pump's harvest stage,
+        so the two loops account identically."""
         if len(results) != len(batch):
             raise RuntimeError(
                 f"dispatch returned {len(results)} results for a "
@@ -140,6 +216,31 @@ class AdmissionQueue:
         )
         self.completed += len(batch)
         return results
+
+    def admission_wait_summary(self) -> dict:
+        """p50/p99 of the recorded submit->dispatch waits, in ms (the
+        CLI `serve` summary and the bench serve_async block surface
+        this next to qps)."""
+        if not self.admission_waits:
+            return {"n": 0, "p50_ms": 0.0, "p99_ms": 0.0}
+        lat = sorted(self.admission_waits)
+        return {
+            "n": len(lat),
+            "p50_ms": round(1e3 * lat[len(lat) // 2], 3),
+            "p99_ms": round(
+                1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3
+            ),
+        }
+
+    def pump(self, now: float | None = None, *,
+             force: bool = False) -> List[ServeResult]:
+        """Dispatch at most ONE batch: when it is full, when the head
+        request has waited `max_wait_s`, or when `force`d (drain).
+        Returns the delivered results ([] = nothing was ready)."""
+        batch = self._pop_ready(now, force=force)
+        if not batch:
+            return []
+        return self.deliver(batch, self._dispatch(batch))
 
     def drain(self) -> List[ServeResult]:
         """Pump until the queue is empty (partial batches forced) —

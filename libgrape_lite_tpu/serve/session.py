@@ -84,6 +84,7 @@ class ServeSession:
             self._dispatch, self.policy, self._compat_key
         )
         self._workers: Dict[str, Worker] = {}
+        self._pump = None  # the attached AsyncServePump, if any
         self.stats = {
             "queries": 0, "batches": 0, "failed": 0,
             "sequential_fallbacks": 0,
@@ -138,6 +139,13 @@ class ServeSession:
                 "session was built without dyn=; pass dyn=True (or a "
                 "RepackPolicy / DynGraph) to enable live ingest"
             )
+        # with an async pump attached, the superstep-boundary
+        # invariant is an EXPLICIT drain, not an accident of the sync
+        # loop: quiesce the dispatch window before touching the graph
+        # (a no-op when nothing is in flight, e.g. when the pump's own
+        # ingest barrier already drained it)
+        if self._pump is not None and self._pump.inflight():
+            self._pump.quiesce(reason="ingest")
         # delta from the DynGraph's own counters: one ingest can fold
         # MORE than once (staging past capacity repacks mid-batch), so
         # the final report's mode alone undercounts
@@ -188,11 +196,15 @@ class ServeSession:
         # lookup failure into per-request error results instead
         if req.app_key not in self.apps:
             return (req.app_key, "?unknown")
-        app_cls = type(self.worker(req.app_key).app)
+        # batch_query_key is a CLASS attribute: read it off the
+        # registered app class directly — instantiating the resident
+        # Worker here (as this method once did) built state and pack
+        # plans while the queue was merely PICKING a batch, so a bare
+        # submit of a never-dispatched app paid a full worker warmup
         return compat_key(
             req.app_key, req.args, req.max_rounds,
             req.guard or self.guard,
-            getattr(app_cls, "batch_query_key", None),
+            getattr(self.apps[req.app_key], "batch_query_key", None),
         )
 
     def submit(self, app_key: str, args: dict | None = None, *,
@@ -207,6 +219,16 @@ class ServeSession:
 
     def drain(self) -> List[ServeResult]:
         return self.queue.drain()
+
+    def async_pump(self, window: int | None = None):
+        """An AsyncServePump over this session (serve/pipeline.py):
+        up to `window` coalesced batches dispatched-but-unharvested at
+        once (default: `policy.inflight`).  W=1 is byte- and
+        result-order-identical to the synchronous `pump`/`drain`
+        loop; the synchronous loop itself is untouched either way."""
+        from libgrape_lite_tpu.serve.pipeline import AsyncServePump
+
+        return AsyncServePump(self, window=window)
 
     def serve(self, stream) -> List[ServeResult]:
         """Scripted-stream convenience: submit every item, drain, and

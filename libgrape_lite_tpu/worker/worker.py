@@ -102,6 +102,141 @@ def _jit_with_chunk_digest(sm, state, eph):
     return jax.jit(with_digest)
 
 
+class BatchDispatch:
+    """One dispatched (possibly still in-flight) batched query: the
+    un-synced outputs of a `Worker.query_batch_dispatch` call, held
+    SELF-CONTAINED so a window of W dispatches can coexist without
+    clobbering the worker's per-query result fields (`batch_rounds`,
+    `_result_state`, ...) — the deferred batch-result surface the
+    async serve pump (serve/pipeline.py) harvests from.
+
+    Nothing here forces a host sync until asked: `is_ready()` polls,
+    `wait()` syncs the per-lane verdicts (rounds / terminate codes —
+    a few int32s), and `lane_values(b)` does the per-lane extraction
+    (device_get + finalize) the harvest stage overlaps with the next
+    batch's device execution."""
+
+    __slots__ = ("app", "fragment", "eph", "state", "rounds_v",
+                 "active_v", "breaches", "batch", "guarded",
+                 "supersteps_counted", "_rounds", "_active")
+
+    def __init__(self, *, app, fragment, eph, state, rounds_v,
+                 active_v, batch, breaches=None, guarded=False,
+                 supersteps_counted=False):
+        self.app = app
+        self.fragment = fragment
+        self.eph = frozenset(eph)
+        self.state = state  # {**carry, **eph} — device (or synced) refs
+        self.rounds_v = rounds_v
+        self.active_v = active_v
+        self.batch = batch
+        self.breaches = (
+            list(breaches) if breaches is not None else [None] * batch
+        )
+        self.guarded = guarded
+        # guarded dispatches count supersteps inside their chunk loop;
+        # unguarded ones are counted by whoever harvests (the rounds
+        # are not known until the dispatch settles)
+        self.supersteps_counted = supersteps_counted
+        self._rounds = None
+        self._active = None
+
+    def is_ready(self) -> bool:
+        """True when the dispatch has settled (no sync forced); a
+        backend without `jax.Array.is_ready` reports True and the
+        first harvest simply blocks."""
+        probe = getattr(self.rounds_v, "is_ready", None)
+        return bool(probe()) if callable(probe) else True
+
+    def wait(self) -> "BatchDispatch":
+        """Sync the per-lane verdicts; values stay deferred per lane."""
+        if self._rounds is None:
+            self._rounds = np.asarray(self.rounds_v)
+            self._active = np.asarray(self.active_v)
+        return self
+
+    @property
+    def rounds(self) -> np.ndarray:
+        return self.wait()._rounds
+
+    @property
+    def terminate(self) -> np.ndarray:
+        return np.minimum(0, self.wait()._active)
+
+    def lane_state(self, lane: int):
+        """Lane `lane`'s carry view (ephemeral leaves are shared)."""
+        return {
+            k: (v if k in self.eph else v[lane])
+            for k, v in self.state.items()
+        }
+
+    def lane_values(self, lane: int) -> np.ndarray:
+        """Per-vertex assembled values for one lane, [fnum, vp] numpy —
+        the host-sync the harvest stage pays lazily."""
+        host = jax.device_get(self.lane_state(lane))
+        return self.app.finalize(self.fragment, host)
+
+
+class PreparedBatch:
+    """A batched query with its host-side work DONE (state built and
+    placed, runner resolved through the cache) but its execution not
+    yet enqueued.  The async pump prepares ahead under the window and
+    staggers `launch()` calls so executions never oversubscribe the
+    backend (on the CPU fallback two concurrent XLA executions fight
+    for the same cores; on a real accelerator the device queue
+    serialises them anyway) while preparation and result extraction
+    overlap whatever IS executing.  Guarded batches carry their args
+    instead: the chunked monitor loop cannot split, so launch() runs
+    it whole (serve/batch.py)."""
+
+    __slots__ = ("worker", "app", "fragment", "eph", "runner", "carry",
+                 "eph_part", "batch", "guarded", "_guard_args")
+
+    def __init__(self, *, worker, app, fragment, eph=None, runner=None,
+                 carry=None, eph_part=None, batch=0, guarded=False,
+                 guard_args=None):
+        self.worker = worker
+        self.app = app
+        self.fragment = fragment
+        self.eph = eph
+        self.runner = runner
+        self.carry = carry
+        self.eph_part = eph_part
+        self.batch = batch
+        self.guarded = guarded
+        self._guard_args = guard_args
+
+    def launch(self) -> "BatchDispatch":
+        """Enqueue the execution (no host sync for unguarded batches —
+        the refs ride back un-synced; guarded batches run their chunk
+        loop here, which probes at boundaries by design)."""
+        if self.guarded:
+            from libgrape_lite_tpu.serve.batch import run_guarded_batch
+
+            args_list, mr, guard_cfg = self._guard_args
+            w = self.worker
+            run_guarded_batch(w, args_list, mr, guard_cfg)
+            return BatchDispatch(
+                app=self.app, fragment=self.fragment,
+                eph=frozenset(
+                    getattr(self.app, "ephemeral_keys", ()) or ()
+                ),
+                state=w._result_state,
+                rounds_v=np.asarray(w.batch_rounds).copy(),
+                active_v=np.asarray(w.batch_terminate).copy(),
+                batch=self.batch, breaches=w.batch_breaches,
+                guarded=True, supersteps_counted=True,
+            )
+        out_state, rounds_v, active_v = self.runner(
+            self.fragment.dev, self.carry, self.eph_part
+        )
+        return BatchDispatch(
+            app=self.app, fragment=self.fragment, eph=self.eph,
+            state={**out_state, **self.eph_part},
+            rounds_v=rounds_v, active_v=active_v, batch=self.batch,
+        )
+
+
 def _unsqueeze_lane_state(state, squeezed):
     return {
         k: (v[:, None] if k in squeezed else v) for k, v in state.items()
@@ -865,6 +1000,67 @@ class Worker:
         """Per-vertex assembled values for one lane, [fnum, vp] numpy."""
         host = jax.device_get(self.batch_lane_state(lane))
         return self.app.finalize(self.fragment, host)
+
+    def query_batch_prepare(self, args_list,
+                            max_rounds: int | None = None, *,
+                            guard=None) -> PreparedBatch:
+        """Do the HOST half of a batched dispatch — same checks, same
+        state build/placement, same cached runner as `query_batch`
+        (so a W=1 pump is byte-identical to the synchronous loop) —
+        and return a PreparedBatch whose `launch()` enqueues the
+        execution.  The async serve pump (serve/pipeline.py) prepares
+        ahead under its window and staggers launches; the worker's own
+        per-query result fields are left untouched, so W dispatches
+        can coexist.
+
+        Guarded batches defer the whole chunked per-lane monitor loop
+        (serve/batch.py) to launch() — breach isolation needs probe
+        verdicts, which sync at every chunk boundary by design — and
+        their verdict arrays are SNAPSHOT into the launched handle, so
+        a guarded batch mid-window never clobbers a neighbour's
+        verdicts and its per-lane values still harvest lazily."""
+        self._check_batchable()
+        self._check_dyn_view()
+        app = self.app
+        frag = self.fragment
+        mr = app.max_rounds if max_rounds is None else max_rounds
+
+        from libgrape_lite_tpu.guard.config import GuardConfig
+
+        guard_cfg = GuardConfig.resolve(guard)
+        batch = len(args_list)
+        if guard_cfg.enabled:
+            return PreparedBatch(
+                worker=self, app=app, fragment=frag, batch=batch,
+                guarded=True,
+                guard_args=(list(args_list), mr, guard_cfg),
+            )
+
+        state = self._place_state_batch(
+            app.init_state_batch(frag, args_list)
+        )
+        runner = self._batched_runner_for(mr, batch, state)
+        # AFTER init_state_batch: overlay-contracted apps extend their
+        # ephemeral set there (dyn edge streams ride as shared eph
+        # leaves), exactly as query_batch reads it
+        eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        carry = {k: v for k, v in state.items() if k not in eph}
+        eph_part = {k: v for k, v in state.items() if k in eph}
+        return PreparedBatch(
+            worker=self, app=app, fragment=frag, eph=eph,
+            runner=runner, carry=carry, eph_part=eph_part, batch=batch,
+        )
+
+    def query_batch_dispatch(self, args_list,
+                             max_rounds: int | None = None, *,
+                             guard=None) -> BatchDispatch:
+        """Prepare AND launch in one call: k point queries dispatched
+        without waiting, outputs riding back un-synced in a
+        self-contained BatchDispatch (JAX async dispatch).  The
+        one-shot surface for callers that do not stagger launches."""
+        return self.query_batch_prepare(
+            args_list, max_rounds, guard=guard
+        ).launch()
 
     def query(self, max_rounds: int | None = None, *,
               checkpoint_every: int | None = None,

@@ -204,8 +204,40 @@ print(f"  OK (24 queries live, {d['ingested']} ops ingested at "
       "applies, 0 repacks)")
 EOF
 
+echo "== async serve pump: --inflight 4 cmp-identical to --inflight 1 (fnum=2) =="
+# the dispatch-window smoke (serve/pipeline.py): the SAME mixed query
+# stream + 10-op delta stream through the CLI at window depth 1 and 4
+# — per-query value digests (--dump_results, submit order) must be
+# byte-identical, the ingest stays overlay-only (zero repacks), and
+# the W=4 run must actually engage the window (pump block present,
+# batches overlapped).  max_batch 4 with ingest_every 16 keeps TWO
+# batches per ingest group, so the window genuinely overlaps.
+for w in 1 4; do
+  python -m libgrape_lite_tpu.cli serve \
+    --efile "$DS/p2p-31.e" --vfile "$DS/p2p-31.v" $PLATFORM_ARGS --fnum 2 \
+    --stream "$OUT/dyn_stream.txt" --max_batch 4 \
+    --delta_stream "$OUT/dyn_delta.txt" --ingest_every 16 \
+    --dyn_repack_ratio 0.5 --inflight $w \
+    --dump_results "$OUT/async_w$w.res" > "$OUT/async_w$w.json"
+done
+cmp "$OUT/async_w1.res" "$OUT/async_w4.res" \
+  || { echo "ASYNC PUMP (W=4) DIVERGED FROM THE SYNC LOOP (W=1)" >&2; exit 1; }
+python - "$OUT/async_w4.json" <<'EOF'
+import json, sys
+rec = json.loads(
+    [l for l in open(sys.argv[1]) if l.startswith("{")][-1])
+assert rec["queries"] == 24 and rec["failed"] == 0, rec
+assert rec["dyn"]["ingested"] == 10 and rec["dyn"]["repack_count"] == 0, rec["dyn"]
+p = rec["pump"]
+assert p["window"] == 4 and p["engaged"] >= 1, p
+assert p["max_inflight"] >= 2, p  # the window genuinely held >1 batch
+print(f"  OK (cmp-identical across windows; engaged={p['engaged']}, "
+      f"max_inflight={p['max_inflight']}, "
+      f"overlapped={p['overlapped_harvests']})")
+EOF
+
 echo "== grape-lint: static contract rules, zero unsuppressed findings =="
-# the AST gate (R1-R5, analysis/): exits 1 on any finding the
+# the AST gate (R1-R7, analysis/): exits 1 on any finding the
 # baseline does not name, 3 if the --json record drifts from its own
 # declared schema — both fail this harness (set -e)
 python scripts/grape_lint.py --json > "$OUT/lint.json"

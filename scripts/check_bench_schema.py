@@ -41,6 +41,7 @@ _TOP = {
     "pack_ledger": (dict, False),
     "obs": (dict, False),
     "serve": (dict, False),
+    "serve_async": (dict, False),
     "dyn": (dict, False),
     "pipeline": (dict, False),
     "partition2d": (dict, False),
@@ -102,6 +103,32 @@ _SERVE_POINT = {
     "n": (int, True),
     "ok": (int, True),
 }
+
+# the r12 async-pump lane (serve/pipeline.py, docs/SERVING.md): the
+# dispatch-window A/B — W in {1, 4} at batch sizes {1, 8, 32} over the
+# serve-scale twin WITH a concurrent delta-ingest stream.  `window_ab`
+# holds w<k> -> b<k> -> point maps; each point is a _SERVE_POINT plus
+# the sustained updates/s of its run.  `identical` is the per-query
+# byte-identity verdict W=4 vs W=1 (bench exits 2 when it breaks),
+# `overlay_recompiles` counts XLA compiles during the measured
+# overlay-only ingests (must be 0 — compile_events), and qps_win_b8
+# is the headline: measured W=4 / W=1 qps at b=8.  Verdict fields are
+# DECLARED bool, like the pipeline lane's.
+_SERVE_ASYNC = {
+    "scale": (int, True),
+    "app": (str, True),
+    "queries": (int, True),
+    "window_ab": (dict, True),
+    "identical": (bool, True),
+    "qps_win_b8": (_NUM, True),
+    "updates_per_chunk": (int, True),
+    "overlay_recompiles": (int, True),
+    "admission_wait_ms": (dict, True),
+    "declines": (dict, False),
+}
+
+_SERVE_ASYNC_POINT = dict(_SERVE_POINT)
+_SERVE_ASYNC_POINT["updates_per_s"] = (_NUM, True)
 
 # the r10 dynamic-graph lane (dyn/, docs/DYNAMIC_GRAPHS.md): updates
 # ingested per second while a query stream stays live, repack vs
@@ -222,6 +249,7 @@ SCHEMA = {
     "pack_ledger": _PACK_LEDGER,
     "obs": _OBS,
     "serve": _SERVE,
+    "serve_async": _SERVE_ASYNC,
     "dyn": _DYN,
     "pipeline": _PIPELINE,
     "partition2d": _PARTITION2D,
@@ -268,7 +296,8 @@ def validate_record(record) -> list:
     _check_block(record, _TOP, "record", errors)
     for key, spec in (("sssp", _SSSP), ("guard", _GUARD),
                       ("pack_ledger", _PACK_LEDGER), ("obs", _OBS),
-                      ("serve", _SERVE), ("dyn", _DYN),
+                      ("serve", _SERVE),
+                      ("serve_async", _SERVE_ASYNC), ("dyn", _DYN),
                       ("pipeline", _PIPELINE),
                       ("partition2d", _PARTITION2D),
                       ("spgemm", _SPGEMM)):
@@ -342,6 +371,39 @@ def validate_record(record) -> list:
                         f"serve.batch_hist[{k!r}]: expected int count, "
                         f"got {type(v).__name__}"
                     )
+    sa = record.get("serve_async")
+    if isinstance(sa, dict):
+        wab = sa.get("window_ab")
+        if isinstance(wab, dict):
+            for wkey, points in wab.items():
+                where = f"serve_async.window_ab[{wkey!r}]"
+                if not (wkey.startswith("w") and wkey[1:].isdigit()):
+                    errors.append(f"{where}: window keys look like w<k>")
+                    continue
+                if not isinstance(points, dict):
+                    errors.append(f"{where}: expected object")
+                    continue
+                for bkey, point in points.items():
+                    pwhere = f"{where}[{bkey!r}]"
+                    if not (bkey.startswith("b") and bkey[1:].isdigit()):
+                        errors.append(
+                            f"{pwhere}: batch keys look like b<k>"
+                        )
+                        continue
+                    if not isinstance(point, dict):
+                        errors.append(f"{pwhere}: expected object")
+                        continue
+                    _check_block(point, _SERVE_ASYNC_POINT, pwhere,
+                                 errors)
+        aw = sa.get("admission_wait_ms")
+        if isinstance(aw, dict):
+            for q in ("p50", "p99"):
+                v = aw.get(q)
+                if not isinstance(v, _NUM) or isinstance(v, bool):
+                    errors.append(
+                        f"serve_async.admission_wait_ms.{q}: expected "
+                        f"number, got {type(v).__name__}"
+                    )
     return errors
 
 
@@ -397,7 +459,8 @@ def main(argv=None) -> int:
                     print(f"  - {e}")
             else:
                 blocks = [k for k in ("sssp", "guard", "pack_ledger",
-                                      "obs", "serve", "dyn", "pipeline",
+                                      "obs", "serve", "serve_async",
+                                      "dyn", "pipeline",
                                       "partition2d", "spgemm")
                           if k in record]
                 print(f"OK {label} ({record.get('metric')}"

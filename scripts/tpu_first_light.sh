@@ -79,6 +79,19 @@ GRAPE_BENCH_ASSUME_ALIVE=1 GRAPE_LCC_BACKEND=spgemm \
 grep -h "\[bench\] spgemm" "$OUT/bench_lcc_int.err" \
   "$OUT/bench_lcc_sp.err" | tail -4 || true
 
+echo "== serve async-pump A/B (dispatch window, serve/pipeline.py —
+the bench's own serve_async lane interleaves W=1 vs W=4 at b in
+{1,8,32} with concurrent barrier ingest and gates on per-query byte
+identity + zero overlay recompiles; on TPU the launch cap defaults to
+the full window because the device queue serialises programs without
+stealing host cores — the overlap the CPU fallback could not show;
+docs/SERVING.md \"The async pump\") =="
+GRAPE_BENCH_ASSUME_ALIVE=1 timeout 3600 python bench.py \
+  2> "$OUT/bench_serve_async.err" | tee "$OUT/bench_serve_async.json" \
+  || true
+grep -h "\[bench\] serve_async" "$OUT/bench_serve_async.err" \
+  | tail -8 || true
+
 echo "== per-stage profile (stepwise mode, per-round wall clock) =="
 GRAPE_SPMV=pack GRAPE_TPU_VLOG=1 timeout 1200 python - <<'EOF' 2>&1 | tee "$OUT/profile.log" || true
 import sys

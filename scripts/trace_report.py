@@ -26,6 +26,13 @@ GRAPE_TRACE / --trace / obs.configure and prints:
   (r10, docs/PARTITION2D.md): one labeled row per (row, col) tile
   with its edge count and share of the max tile, plus the
   max-tile-skew summary;
+* the async serve-pump table when the trace carries serve_dispatch/
+  serve_harvest spans (r12, serve/pipeline.py): one row per batch
+  with dispatch and harvest lag and the window occupancy at harvest,
+  plus the hidden-harvest fraction — harvest wall spent while other
+  batches were still in flight — and a PUMP DRIFT flag when a W>1
+  window is armed but hides <10% of the harvest wall (the window is
+  paying its bookkeeping and buying no overlap);
 * a phase rollup (obs.rollup) for the non-superstep spans.
 
 Usage: python scripts/trace_report.py TRACE [--drift-x 2.0]
@@ -139,6 +146,85 @@ def query_pipeline(events):
                 if "overlap_hidden_us" in args:
                     pl["overlap_hidden_us"] = args["overlap_hidden_us"]
     return pl
+
+
+def serve_pump_rows(events):
+    """(dispatch, harvest) span pairs of the async serve pump, in
+    dispatch order: one row per batch with its dispatch/harvest lag
+    and the window occupancy at harvest (serve/pipeline.py tags every
+    span with window/inflight/overlapped)."""
+    disp = sorted(
+        (ev for ev in events
+         if ev.get("ph") == "X" and ev.get("name") == "serve_dispatch"),
+        key=lambda e: float(e.get("ts", 0)),
+    )
+    harv = sorted(
+        (ev for ev in events
+         if ev.get("ph") == "X" and ev.get("name") == "serve_harvest"),
+        key=lambda e: float(e.get("ts", 0)),
+    )
+    rows = []
+    # FIFO harvest: the i-th harvest drains the i-th dispatch
+    for i, h in enumerate(harv):
+        d = disp[i] if i < len(disp) else None
+        da = (d.get("args") or {}) if d else {}
+        ha = h.get("args") or {}
+        rows.append({
+            "app": ha.get("app", da.get("app", "?")),
+            "batch": ha.get("batch", da.get("batch", 0)),
+            "mode": ha.get("mode", "?"),
+            "dispatch_us": float(d.get("dur", 0)) if d else None,
+            "harvest_us": float(h.get("dur", 0)),
+            "occupancy": ha.get("inflight", 0),
+            "overlapped": bool(ha.get("overlapped", False)),
+            "window": ha.get("window", da.get("window", 1)),
+        })
+    return rows
+
+
+def render_serve_pump(rows, out=sys.stdout) -> int:
+    """The async-pump section: per-batch dispatch/harvest lag + window
+    occupancy, the hidden-harvest fraction, and the PUMP DRIFT flag
+    (W>1 armed but <10% of the harvest wall overlapped with in-flight
+    work).  Returns 1 when flagged, else 0."""
+    if not rows:
+        return 0
+    print("\nasync serve pump (serve_dispatch/serve_harvest spans, "
+          "serve/pipeline.py):", file=out)
+    print(f"{'batch':>5} {'app':>10} {'lanes':>6} {'mode':>9} "
+          f"{'disp_ms':>10} {'harv_ms':>10} {'occ':>4}  ovl", file=out)
+    total = hidden = 0.0
+    for i, r in enumerate(rows):
+        total += r["harvest_us"]
+        if r["overlapped"]:
+            hidden += r["harvest_us"]
+        print(
+            f"{i:>5} {r['app']:>10} {r['batch']:>6} {r['mode']:>9} "
+            f"{_fmt_ms(r['dispatch_us'])} {_fmt_ms(r['harvest_us'])} "
+            f"{r['occupancy']:>4}  {'y' if r['overlapped'] else '-'}",
+            file=out,
+        )
+    armed = any(r["window"] > 1 for r in rows)
+    frac = hidden / total if total > 0 else 0.0
+    occ = [r["occupancy"] for r in rows]
+    print(
+        f"  window={'/'.join(str(w) for w in sorted({r['window'] for r in rows}))} "
+        f"occupancy mean={sum(occ) / len(occ):.2f} max={max(occ)} "
+        f"hidden harvest wall {frac:.1%}",
+        file=out,
+    )
+    if armed and frac < 0.10:
+        print(
+            "  PUMP DRIFT: a W>1 window is armed but <10% of the "
+            f"harvest wall overlapped in-flight work ({frac:.1%}) — "
+            "the stream never kept the window full (batch cadence too "
+            "coarse, declines forcing the sync path, or ingest "
+            "barriers quiescing every step; see PUMP_STATS and "
+            "docs/SERVING.md)",
+            file=out,
+        )
+        return 1
+    return 0
 
 
 def drift_flags(rows, drift_x: float):
@@ -255,6 +341,7 @@ def render(events, drift_x: float = DRIFT_X, out=sys.stdout):
                 f"{t.get('edges', 0) / mx:>7.2f}",
                 file=out,
             )
+    pump_flagged = render_serve_pump(serve_pump_rows(events), out)
     if flagged:
         print(
             f"\n{flagged} superstep(s) drifted >{drift_x}x from the "
@@ -269,10 +356,11 @@ def render(events, drift_x: float = DRIFT_X, out=sys.stdout):
             f"  {name:<20} n={r['count']:<4} total={r['total_s']:.4f}s "
             f"mean={r['mean_s']:.4f}s max={r['max_s']:.4f}s", file=out,
         )
-    # superstep x_med drift and the pipeline <10%-hidden flag are
-    # counted separately (the summary above names only the former);
-    # callers get the total so either kind reads as "worth a look"
-    return flagged + pipe_flagged
+    # superstep x_med drift, the pipeline <10%-hidden flag, and the
+    # serve-pump <10%-hidden flag are counted separately (the summary
+    # above names only the first); callers get the total so any kind
+    # reads as "worth a look"
+    return flagged + pipe_flagged + pump_flagged
 
 
 def main(argv=None) -> int:

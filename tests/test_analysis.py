@@ -413,6 +413,103 @@ def test_r6_shipped_incevals_are_clean():
         assert not r6, f"{mod}: {[f.message for f in r6]}"
 
 
+# ---- R7: host syncs on the async pump's dispatch stage --------------------
+
+_PUMP_PATH = "libgrape_lite_tpu/serve/pipeline.py"
+
+
+def test_r7_trips_on_asarray_in_dispatch_stage():
+    # np.asarray on the dispatch path materialises the device buffer —
+    # the sync re-serialises the window the pump exists to keep full
+    src = """
+    import numpy as np
+
+    class Pump:
+        def _fill(self, force=False):
+            self._dispatch(self.queue.pop())
+
+        def _dispatch(self, batch):
+            out, rounds, active = self.runner(batch)
+            return np.asarray(rounds)
+    """
+    assert "R7" in _rules(src, _PUMP_PATH)
+
+
+def test_r7_trips_on_int_of_device_value_in_dispatch_stage():
+    src = """
+    class Pump:
+        def _dispatch_stage(self, batch):
+            d = self.worker.dispatch(batch)
+            return int(d.rounds[0])
+    """
+    assert "R7" in _rules(src, _PUMP_PATH)
+
+
+def test_r7_is_path_scoped_to_the_pump_module():
+    # the synchronous session/queue loop is ALLOWED to sync — the
+    # contract binds only serve/pipeline.py dispatch-stage code
+    src = """
+    import numpy as np
+
+    class Session:
+        def _dispatch(self, batch):
+            return np.asarray(self.runner(batch))
+    """
+    assert "R7" not in _rules(
+        src, "libgrape_lite_tpu/serve/session.py"
+    )
+    assert "R7" in _rules(src, _PUMP_PATH)
+
+
+def test_r7_passes_when_sync_lives_in_the_harvest_contract():
+    # _harvest_head / _run_declined are named in PUMP_HARVEST_SYNCS:
+    # syncs there are the audited harvest stage, and a dispatch chain
+    # that routes THROUGH a contract method stops being audited at it
+    src = """
+    import jax
+    import numpy as np
+
+    class Pump:
+        def _fill(self, force=False):
+            self._dispatch_stage(self.queue.pop())
+
+        def _dispatch_stage(self, batch):
+            return self._run_declined(batch)
+
+        def _run_declined(self, batch):
+            return jax.block_until_ready(self.session._dispatch(batch))
+
+        def _harvest_head(self, pb):
+            return np.asarray(pb.rounds)
+    """
+    assert "R7" not in _rules(src, _PUMP_PATH)
+
+
+def test_r7_nested_thunks_are_harvest_time():
+    # a deferred thunk BUILT at dispatch time runs at harvest time —
+    # the lazy-values form, not a dispatch-stage sync
+    src = """
+    class Pump:
+        def _dispatch_stage(self, batch):
+            d = self.worker.dispatch(batch)
+            return lambda: int(d.rounds[0])
+    """
+    assert "R7" not in _rules(src, _PUMP_PATH)
+
+
+def test_r7_shipped_pump_is_clean():
+    # zero-entry baseline: the shipped dispatch stage holds no syncs
+    import os
+
+    import libgrape_lite_tpu
+
+    root = os.path.dirname(libgrape_lite_tpu.__file__)
+    with open(os.path.join(root, "serve", "pipeline.py")) as fh:
+        src = fh.read()
+    r7 = [f for f in lint_source(src, _PUMP_PATH) if f.rule == "R7"]
+    assert not r7, [f.message for f in r7]
+
+
 # ---- baseline round-trip --------------------------------------------------
 
 

@@ -1,0 +1,566 @@
+"""serve/pipeline.py — the async serving pump (ISSUE 12 acceptance).
+
+Pins: the W=1 pump is byte-identical and result-order-identical to
+the synchronous loop across the full serve matrix (batched, guarded,
+sequential-fallback, unknown-app, dyn-ingest); a W>1 window returns
+the same bytes while genuinely holding multiple batches in flight; a
+waiting batch is never starved by a full window (max_wait + forced
+partials); a guarded lane breach with W>1 batches in flight stays
+isolated to its lane; `ingest` is an explicit window barrier and
+overlay-only ingests stay zero-recompile under the pump; the
+deferred-values form of ServeResult resolves lazily and once; the
+admission queue records per-request submit->dispatch waits; batch
+PICKING builds no resident worker; and PUMP_STATS records every
+engage/decline, including the GRAPE_SERVE_INFLIGHT override.
+"""
+
+import numpy as np
+import pytest
+
+from tests.test_serve import SOURCES, _sequential
+
+
+def _pump_serve(frag, stream, *, window, policy=None, guard=None,
+                dyn=None):
+    """Run `stream` through a session under an AsyncServePump."""
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    sess = ServeSession(frag, policy=policy or BatchPolicy(max_batch=4),
+                        guard=guard, dyn=dyn)
+    pump = sess.async_pump(window=window)
+    for app_key, args in stream:
+        sess.submit(app_key, args)
+    return sess, pump.drain()
+
+
+def _sync_serve(frag, stream, *, policy=None, guard=None, dyn=None):
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    sess = ServeSession(frag, policy=policy or BatchPolicy(max_batch=4),
+                        guard=guard, dyn=dyn)
+    return sess, sess.serve(stream)
+
+
+def _assert_identical(res_sync, res_pump):
+    """Byte-identical values, identical order/rounds/outcomes."""
+    assert len(res_sync) == len(res_pump)
+    for a, b in zip(res_sync, res_pump):
+        assert a.app_key == b.app_key
+        assert a.ok == b.ok, (a.error, b.error)
+        assert a.rounds == b.rounds
+        assert a.batch_size == b.batch_size
+        if a.ok:
+            assert a.values.tobytes() == b.values.tobytes(), (
+                f"pump diverged from sync loop for {a.app_key} "
+                f"(request {a.request_id} vs {b.request_id})"
+            )
+
+
+# ---- W=1 identity matrix --------------------------------------------------
+
+
+@pytest.mark.parametrize("window", [1, 4])
+def test_pump_batched_identical_to_sync(graph_cache, window):
+    """The coalesced multi-source path: same bytes, same order, same
+    batch histogram at W=1 AND W=4."""
+    frag = graph_cache(2)
+    stream = [("sssp", {"source": s}) for s in [6, 17, 3, 42, 11, 12]]
+    s0, r0 = _sync_serve(frag, stream)
+    s1, r1 = _pump_serve(frag, stream, window=window)
+    _assert_identical(r0, r1)
+    assert s1.queue.batch_hist == s0.queue.batch_hist
+
+
+def test_pump_guarded_batches_identical_to_sync(graph_cache):
+    """Guarded batched dispatch through the pump: the chunked per-lane
+    monitor loop runs at dispatch time, values harvest lazily — bytes
+    unchanged."""
+    frag = graph_cache(2)
+    stream = [("sssp", {"source": s}) for s in SOURCES]
+    s0, r0 = _sync_serve(frag, stream, guard="halt")
+    s1, r1 = _pump_serve(frag, stream, window=3, guard="halt")
+    _assert_identical(r0, r1)
+
+
+def test_pump_sequential_fallback_declined_and_identical(graph_cache):
+    """Host-only apps cannot ride the window: the pump declines to the
+    session's synchronous loop, records it, and returns the same
+    results."""
+    from libgrape_lite_tpu.serve import PUMP_STATS
+
+    frag = graph_cache(2)
+    stream = [("sssp_msg", {"source": 6}), ("sssp_msg", {"source": 6})]
+    s0, r0 = _sync_serve(frag, stream)
+    PUMP_STATS.reset()
+    s1, r1 = _pump_serve(frag, stream, window=4)
+    _assert_identical(r0, r1)
+    assert s1.stats["sequential_fallbacks"] == 1
+    assert PUMP_STATS.snapshot()["declines"]["sequential_fallback"] >= 1
+
+
+def test_pump_unknown_app_fails_without_wedging(graph_cache):
+    frag = graph_cache(2)
+    stream = [("not_an_app", {"source": 1}), ("sssp", {"source": 6})]
+    s0, r0 = _sync_serve(frag, stream)
+    s1, r1 = _pump_serve(frag, stream, window=4)
+    _assert_identical(r0, r1)
+    assert not r1[0].ok and "unknown application" in r1[0].error["error"]
+    assert r1[1].ok
+
+
+def test_pump_single_query_identical_to_sync(graph_cache):
+    """A 1-lane batch rides the window as a batched-1 dispatch — the
+    per-lane freeze-mask identity makes it byte-identical to the sync
+    loop's plain fused path."""
+    frag = graph_cache(2)
+    from libgrape_lite_tpu.serve import BatchPolicy
+
+    stream = [("sssp", {"source": 6}), ("bfs", {"source": 17})]
+    s0, r0 = _sync_serve(frag, stream, policy=BatchPolicy(max_batch=1))
+    s1, r1 = _pump_serve(frag, stream, window=2,
+                         policy=BatchPolicy(max_batch=1))
+    _assert_identical(r0, r1)
+
+
+# ---- dyn ingest under the pump -------------------------------------------
+
+
+def _dyn_run(window):
+    """Interleaved query/ingest sequence, sync (window=None) or
+    pumped; returns (session, pump, results in delivery order)."""
+    from libgrape_lite_tpu.dyn import RepackPolicy
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+    from tests.test_dyn import ADDS, build_graph
+
+    sess = ServeSession(
+        build_graph(2), policy=BatchPolicy(max_batch=4),
+        dyn=RepackPolicy(capacity=4096),
+    )
+    pump = sess.async_pump(window=window) if window else None
+    out = []
+    for s in [0, 5, 9, 13]:
+        sess.submit("sssp", {"source": s})
+    out += pump.drain() if pump else sess.drain()
+    (pump.ingest if pump else sess.ingest)(ADDS)
+    for s in [0, 5, 9, 13]:
+        sess.submit("sssp", {"source": s})
+    out += pump.drain() if pump else sess.drain()
+    return sess, pump, out
+
+
+def test_pump_dyn_ingest_identical_across_windows():
+    """Live overlay ingest between batches: sync, W=1 and W=4 runs
+    return the same bytes for the pre- AND post-delta queries."""
+    _, _, r0 = _dyn_run(None)
+    _, _, r1 = _dyn_run(1)
+    s4, p4, r4 = _dyn_run(4)
+    _assert_identical(r0, r1)
+    _assert_identical(r0, r4)
+    assert s4.stats["overlay_applies"] >= 1
+    assert s4.stats["repacks"] == 0
+
+
+def test_pump_overlay_ingest_zero_recompiles():
+    """The zero-recompile contract survives the pump: after the
+    overlay shape is warm, a barrier ingest + warmed queries compile
+    NOTHING (the real XLA compile stream, not cache counters)."""
+    from libgrape_lite_tpu.analysis import compile_events
+    from libgrape_lite_tpu.dyn import RepackPolicy
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+    from tests.test_dyn import build_graph
+
+    sess = ServeSession(
+        build_graph(2), policy=BatchPolicy(max_batch=4),
+        dyn=RepackPolicy(capacity=4096),
+    )
+    pump = sess.async_pump(window=4)
+    for s in [0, 5, 9, 13]:
+        sess.submit("sssp", {"source": s})
+    pump.drain()
+    pump.ingest([("a", 0, 17, 0.01)])  # warm the overlay shape
+    for s in [0, 5, 9, 13]:
+        sess.submit("sssp", {"source": s})
+    pump.drain()
+    with compile_events() as ev:
+        pump.ingest([("a", 1, 18, 0.02)])
+        for s in [0, 5, 9, 13]:
+            sess.submit("sssp", {"source": s})
+        pump.drain()
+    assert ev.compiles == 0, ev.events
+
+
+def test_pump_ingest_is_a_window_barrier():
+    """ingest() quiesces in-flight batches BEFORE the delta applies:
+    they land on the graph they were admitted against, and the window
+    is empty when the overlay mutates."""
+    from libgrape_lite_tpu.dyn import RepackPolicy
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+    from tests.test_dyn import ADDS, build_graph
+
+    sess = ServeSession(
+        build_graph(2), policy=BatchPolicy(max_batch=2),
+        dyn=RepackPolicy(capacity=4096),
+    )
+    pump = sess.async_pump(window=4)
+    reqs = [sess.submit("sssp", {"source": s}) for s in [0, 5, 9, 13]]
+    pump._fill(force=True)  # dispatch both batches, harvest nothing
+    assert pump.inflight() == 2
+    pump.ingest(ADDS)
+    assert pump.inflight() == 0
+    assert pump.stats["quiesces"] == 1
+    assert all(r.done for r in reqs)  # quiesce delivered them
+
+    # the pre-barrier results equal a PRE-delta sync run, and a
+    # post-barrier query equals a POST-delta sync run
+    from tests.test_dyn import build_graph as bg
+
+    ref = ServeSession(bg(2), policy=BatchPolicy(max_batch=2))
+    ref_res = ref.serve([("sssp", {"source": s}) for s in [0, 5, 9, 13]])
+    for got, want in zip([r.result for r in reqs], ref_res):
+        assert got.values.tobytes() == want.values.tobytes()
+
+    post = sess.submit("sssp", {"source": 0})
+    pump.drain()
+    ref2 = ServeSession(
+        bg(2), policy=BatchPolicy(max_batch=2),
+        dyn=RepackPolicy(capacity=4096),
+    )
+    ref2.ingest(ADDS)
+    want2 = ref2.serve([("sssp", {"source": 0})])[0]
+    assert post.result.values.tobytes() == want2.values.tobytes()
+
+
+def test_session_ingest_quiesces_attached_pump():
+    """Calling session.ingest directly (not pump.ingest) must still
+    drain the window first — the barrier is structural, not a calling
+    convention."""
+    from libgrape_lite_tpu.dyn import RepackPolicy
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+    from tests.test_dyn import ADDS, build_graph
+
+    sess = ServeSession(
+        build_graph(2), policy=BatchPolicy(max_batch=2),
+        dyn=RepackPolicy(capacity=4096),
+    )
+    pump = sess.async_pump(window=4)
+    [sess.submit("sssp", {"source": s}) for s in [0, 5]]
+    pump._fill(force=True)
+    assert pump.inflight() == 1
+    sess.ingest(ADDS)  # the session-side surface
+    assert pump.inflight() == 0 and pump.stats["quiesces"] == 1
+
+
+# ---- window mechanics -----------------------------------------------------
+
+
+def test_pump_window_genuinely_overlaps(graph_cache):
+    """W=4 over 4 batches: the window must actually hold >1 dispatch
+    at once and harvest with work still in flight."""
+    frag = graph_cache(2)
+    stream = [("sssp", {"source": 6 + i}) for i in range(16)]
+    s1, r1 = _pump_serve(frag, stream, window=4)
+    assert all(r.ok for r in r1)
+    assert s1._pump.stats["max_inflight"] > 1
+    assert s1._pump.stats["overlapped_harvests"] >= 1
+
+
+def test_pump_full_window_does_not_starve_waiting_batch(graph_cache):
+    """A batch whose head aged past max_wait must ship even when the
+    window is full: the pump harvests the head to make room instead of
+    skipping the dispatch."""
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    frag = graph_cache(2)
+    sess = ServeSession(
+        frag, policy=BatchPolicy(max_batch=4, max_wait_s=60.0)
+    )
+    pump = sess.async_pump(window=1)
+    a = sess.submit("sssp", {"source": 6})
+    sess.submit("sssp", {"source": 17})
+    assert pump.pump() == []  # 2 < max_batch and the head is fresh
+    assert sess.queue.pending() == 2 and pump.inflight() == 0
+    # the head aged past the window: the partial batch dispatches
+    # (filling the W=1 window) and later pumps deliver it
+    pump.pump(now=a.submitted_s + 61.0)
+    assert sess.queue.pending() == 0
+    # a second aged batch behind the full window: pump() must make
+    # room (blocking harvest) rather than starve it
+    b = sess.submit("bfs", {"source": 6})
+    c = sess.submit("bfs", {"source": 17})
+    out = pump.pump(now=b.submitted_s + 61.0)
+    out += pump.pump(now=c.submitted_s + 61.0)
+    out += pump.drain()
+    assert a.done and b.done and c.done
+    assert all(r.result.ok for r in (a, b, c))
+
+
+def test_pump_forced_partial_batches_drain(graph_cache):
+    """drain() forces partial batches through the window exactly like
+    queue.drain does for the sync loop."""
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    frag = graph_cache(2)
+    sess = ServeSession(
+        frag, policy=BatchPolicy(max_batch=8, max_wait_s=3600.0)
+    )
+    pump = sess.async_pump(window=2)
+    reqs = [sess.submit("sssp", {"source": s}) for s in [6, 17, 3]]
+    assert pump.pump() == []  # held: partial and fresh
+    res = pump.drain()
+    assert len(res) == 3 and all(r.ok for r in res)
+    assert sess.queue.batch_hist == {3: 1}
+    assert all(r.done for r in reqs)
+
+
+def test_guarded_breach_mid_window_isolated(graph_cache):
+    """A guarded lane breaches while W>1 batches are in flight: the
+    poisoned lane fails with its bundle, its batchmates AND the other
+    window batches return byte-identical results."""
+    import jax
+
+    from libgrape_lite_tpu.models import APP_REGISTRY
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+    from libgrape_lite_tpu.serve import batch as serve_batch
+
+    frag = graph_cache(2)
+    sources = [6, 17, 3]
+    want, _ = _sequential(frag, APP_REGISTRY["sssp"], [6, 17, 3, 42, 11])
+
+    orig = serve_batch.run_guarded_batch
+
+    def poisoned(worker, args_list, mr, cfg, **kw):
+        def hook(carry, rounds):
+            if rounds != 2:
+                return None
+            dist = np.array(jax.device_get(carry["dist"]))
+            dist[0, 0, :4] = -5.0  # negative distance: in_range breach
+            return {"dist": dist}
+
+        return orig(worker, args_list, mr, cfg, chunk_hook=hook)
+
+    serve_batch.run_guarded_batch = poisoned
+    try:
+        sess = ServeSession(frag, policy=BatchPolicy(max_batch=4))
+        pump = sess.async_pump(window=3)
+        # three compatibility classes -> three window batches: an
+        # unguarded batch, the guarded (poisoned) batch, another
+        # unguarded batch
+        head = [sess.submit("sssp", {"source": s}) for s in [42, 11]]
+        mid = [sess.submit("sssp", {"source": s}, guard="halt")
+               for s in sources]
+        tail = [sess.submit("sssp", {"source": s}) for s in [6, 17]]
+        pump.drain()
+    finally:
+        serve_batch.run_guarded_batch = orig
+    assert not mid[0].result.ok
+    assert mid[0].result.error["verdict"]["kind"] == "invariant"
+    for req, s in zip(mid[1:], sources[1:]):
+        assert req.result.ok
+        assert req.result.values.tobytes() == want[s].tobytes(), (
+            f"breach perturbed guarded batchmate (source {s})"
+        )
+    for req, s in zip(head + tail, [42, 11, 6, 17]):
+        assert req.result.ok
+        assert req.result.values.tobytes() == want[s].tobytes(), (
+            f"breach leaked across the window (source {s})"
+        )
+    assert sess.stats["failed"] == 1
+
+
+def test_launch_failure_fails_its_batch_only(graph_cache, monkeypatch):
+    """A batch whose execution fails at launch/sync time becomes
+    per-lane error results (the sync loop's whole-batch containment)
+    — the pump survives and the rest of the window still serves."""
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+    from libgrape_lite_tpu.worker import worker as worker_mod
+
+    frag = graph_cache(2)
+    sess = ServeSession(frag, policy=BatchPolicy(max_batch=2))
+    pump = sess.async_pump(window=3)
+
+    orig = worker_mod.PreparedBatch.launch
+    state = {"n": 0}
+
+    def flaky(self):
+        state["n"] += 1
+        if state["n"] == 2:  # the second batch's execution blows up
+            raise RuntimeError("synthetic launch failure")
+        return orig(self)
+
+    monkeypatch.setattr(worker_mod.PreparedBatch, "launch", flaky)
+    a = [sess.submit("sssp", {"source": s}) for s in [6, 17]]
+    b = [sess.submit("bfs", {"source": s}) for s in [6, 17]]
+    c = [sess.submit("wcc", {}), ]
+    res = pump.drain()
+    assert len(res) == 5
+    assert all(r.result.ok for r in a), [r.result.error for r in a]
+    assert all(not r.result.ok for r in b)
+    assert "synthetic launch failure" in b[0].result.error["error"]
+    assert all(r.result.ok for r in c)
+    assert sess.stats["failed"] == 2
+
+
+# ---- deferred results, admission waits, stats -----------------------------
+
+
+def test_serve_result_deferred_values_resolve_once():
+    from libgrape_lite_tpu.serve import ServeResult
+
+    calls = []
+
+    def thunk():
+        calls.append(1)
+        return np.arange(4)
+
+    r = ServeResult(request_id=0, app_key="sssp", ok=True,
+                    values_fn=thunk)
+    assert r.deferred
+    assert r.values.tobytes() == np.arange(4).tobytes()
+    assert r.values is r.values  # cached, not re-extracted
+    assert not r.deferred
+    assert calls == [1]
+    # eager construction is unchanged
+    r2 = ServeResult(request_id=1, app_key="sssp", ok=True,
+                     values=np.ones(2))
+    assert not r2.deferred and r2.values.sum() == 2.0
+
+
+def test_pump_lazy_harvest_defers_extraction(graph_cache):
+    """eager_values=False: delivered results carry un-extracted
+    values; the first read pays the sync and matches the eager run."""
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    frag = graph_cache(2)
+    want, _ = _sequential(
+        frag, __import__("libgrape_lite_tpu.models",
+                         fromlist=["APP_REGISTRY"]).APP_REGISTRY["sssp"],
+        [6, 17],
+    )
+    sess = ServeSession(frag, policy=BatchPolicy(max_batch=2))
+    pump = sess.async_pump(window=2)
+    pump.eager_values = False
+    sess.submit("sssp", {"source": 6})
+    sess.submit("sssp", {"source": 17})
+    res = pump.drain()
+    assert all(r.deferred for r in res)
+    assert res[0].values.tobytes() == want[6].tobytes()
+    assert res[1].values.tobytes() == want[17].tobytes()
+    assert not any(r.deferred for r in res)
+
+
+def test_admission_queue_records_waits(graph_cache):
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    frag = graph_cache(2)
+    sess = ServeSession(frag, policy=BatchPolicy(max_batch=4))
+    for s in [6, 17, 3]:
+        sess.submit("sssp", {"source": s})
+    sess.drain()
+    waits = sess.queue.admission_waits
+    assert len(waits) == 3 and all(w >= 0 for w in waits)
+    summ = sess.queue.admission_wait_summary()
+    assert summ["n"] == 3
+    assert summ["p99_ms"] >= summ["p50_ms"] >= 0.0
+
+
+def test_compat_key_pick_builds_no_worker(graph_cache):
+    """Satellite bugfix pin: picking a batch (compat-key resolution)
+    must not instantiate a resident Worker — a submit that never
+    dispatches costs nothing."""
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    frag = graph_cache(2)
+    sess = ServeSession(
+        frag, policy=BatchPolicy(max_batch=4, max_wait_s=3600.0)
+    )
+    sess.submit("sssp", {"source": 6})
+    assert sess._workers == {}
+    # the queue PICKS (computes compat keys) but nothing is ready:
+    # still no worker
+    assert sess.pump() == []
+    assert sess._workers == {}
+    # PPR vs global still split correctly off the class attribute
+    a = sess.submit("pagerank", {"source": 6})
+    b = sess.submit("pagerank", {})
+    assert sess._compat_key(a) != sess._compat_key(b)
+    assert sess._workers == {}
+
+
+def test_pump_stats_records_env_override(graph_cache, monkeypatch):
+    from libgrape_lite_tpu.serve import PUMP_STATS, ServeSession
+
+    frag = graph_cache(2)
+    PUMP_STATS.reset()
+    monkeypatch.setenv("GRAPE_SERVE_INFLIGHT", "1")
+    sess = ServeSession(frag)
+    pump = sess.async_pump(window=4)
+    assert pump.window == 1
+    assert PUMP_STATS.snapshot()["declines"]["inflight_env"] == 1
+
+
+def test_pump_obs_spans(graph_cache):
+    """serve_dispatch/serve_harvest spans carry window + occupancy
+    args (trace_report's serve section reads them) and every query
+    keeps its lane-track attribution."""
+    from libgrape_lite_tpu import obs
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    frag = graph_cache(2)
+    obs.configure(in_memory=True)
+    try:
+        sess = ServeSession(frag, policy=BatchPolicy(max_batch=4))
+        pump = sess.async_pump(window=2)
+        reqs = [sess.submit("sssp", {"source": s}) for s in [6, 17, 3]]
+        pump.drain()
+        evs = obs.history()
+        disp = [e for e in evs if e.get("name") == "serve_dispatch"]
+        harv = [e for e in evs if e.get("name") == "serve_harvest"]
+        assert len(disp) == 1 and len(harv) == 1
+        assert disp[0]["args"]["window"] == 2
+        assert harv[0]["args"]["mode"] == "deferred"
+        assert "overlapped" in harv[0]["args"]
+        lanes = [e for e in evs if e.get("name") == "serve_query"]
+        assert {e["args"]["query_id"] for e in lanes} == {
+            r.id for r in reqs
+        }
+        for e in lanes:
+            assert e["args"]["ok"] is True
+    finally:
+        obs.reset()
+
+
+# ---- CLI surface ----------------------------------------------------------
+
+
+def test_cli_serve_inflight_pump(capsys, tmp_path):
+    """--inflight 2 arms the pump through the real CLI: the summary
+    carries the pump block and the admission-wait percentiles, and
+    --dump_results writes the per-query identity surface."""
+    import json
+
+    from libgrape_lite_tpu.cli import serve_main
+    from tests.conftest import dataset_path
+
+    dump = tmp_path / "res.txt"
+    serve_main([
+        "--efile", dataset_path("p2p-31.e"),
+        "--vfile", dataset_path("p2p-31.v"),
+        "--fnum", "2", "--application", "bfs",
+        "--sources", "6,17,3,42", "--max_batch", "2",
+        "--inflight", "2", "--dump_results", str(dump),
+    ])
+    out = capsys.readouterr().out
+    rec = json.loads(
+        [line for line in out.splitlines() if line.startswith("{")][-1]
+    )
+    assert rec["queries"] == 4 and rec["failed"] == 0
+    assert rec["inflight"] == 2
+    assert rec["pump"]["window"] == 2
+    assert rec["pump"]["engaged"] >= 1
+    assert "p99" in rec["admission_wait_ms"]
+    lines = dump.read_text().strip().splitlines()
+    assert len(lines) == 4
+    for i, line in enumerate(lines):
+        idx, app, ok, rounds, digest = line.split()
+        assert int(idx) == i and app == "bfs" and ok == "1"
+        assert len(digest) == 64
