@@ -67,6 +67,20 @@ is MEASURED on CPU fallback, not modeled.  Env knobs:
 GRAPE_BENCH_NO_SERVE_ASYNC=1 skips, GRAPE_BENCH_SERVE_ASYNC_QUERIES /
 _UPDATES size the lane (scale follows GRAPE_BENCH_SERVE_SCALE).
 
+BENCH-json fleet fields (r13): `fleet` carries the serving-fleet
+drain drill (fleet/, docs/FLEET.md) — R=2 replica sessions behind a
+version-fenced least-outstanding router serving a mixed sssp+khop
+stream with concurrent barrier ingest, replica 0 drained mid-run for
+an offline forced repack and rejoined through its catch-up log.
+`per_replica` maps r0/r1 to sustained qps with p50/p99 (the ROADMAP
+target bench: qps@p99 PER REPLICA), `byte_identical` is the
+per-query verdict vs the undrained R=1 run, `dropped` must be 0
+(zero-downtime), and `readmit_compiles` counts XLA compiles after an
+evict -> re-admit of a replica session (must be 0 — warm host
+artifacts); any verdict failure exits 2.  Env knobs:
+GRAPE_BENCH_NO_FLEET=1 skips, GRAPE_BENCH_FLEET_QUERIES / _UPDATES
+size the lane (scale follows GRAPE_BENCH_SERVE_SCALE).
+
 BENCH-json dyn fields (r10): `dyn` carries the dynamic-graph lane
 (dyn/, docs/DYNAMIC_GRAPHS.md) — `updates_per_s` ingested through
 ServeSession.ingest while an SSSP query stream stays live (overlay
@@ -1353,6 +1367,202 @@ def main():
             print(f"[bench] dyn lane failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
 
+    # serving-fleet lane (r13, ROADMAP item 2b/2c): the drain drill —
+    # R=2 replica sessions behind a version-fenced router serving a
+    # mixed sssp+khop stream (khop = the sampling-shaped workload,
+    # ROADMAP 5c one notch) with a concurrent barrier-ingested delta
+    # stream, one replica drained mid-run for an offline forced
+    # repack and rejoined through its catch-up log.  Gated exit-2 on:
+    # per-query byte identity vs the undrained R=1 run, zero dropped
+    # queries, and zero XLA compiles on an evict -> re-admit of a
+    # replica session (the warm-host-artifact contract).  Reports
+    # sustained qps@p99 PER REPLICA — the ROADMAP's stated target
+    # bench.  GRAPE_BENCH_NO_FLEET=1 skips;
+    # GRAPE_BENCH_FLEET_QUERIES / _UPDATES size the lane.
+    fleet_mismatch = None
+    if not os.environ.get("GRAPE_BENCH_NO_FLEET"):
+        try:
+            from libgrape_lite_tpu.analysis import compile_events
+            from libgrape_lite_tpu.dyn import RepackPolicy
+            from libgrape_lite_tpu.fleet import (
+                FLEET_STATS,
+                FleetRouter,
+                run_fleet_script,
+            )
+            from libgrape_lite_tpu.fragment.mutation import (
+                replicate_fragment,
+            )
+            from libgrape_lite_tpu.serve import (
+                BatchPolicy,
+                ServeSession,
+            )
+
+            sys.path.insert(
+                0, os.path.join(os.path.dirname(os.path.abspath(
+                    __file__)), "scripts"))
+            from gen_rmat import delta_edges
+
+            fl_scale = int(os.environ.get(
+                "GRAPE_BENCH_SERVE_SCALE", min(SCALE, 12)))
+            fl_q = int(os.environ.get(
+                "GRAPE_BENCH_FLEET_QUERIES", 64))
+            fl_upd = int(os.environ.get(
+                "GRAPE_BENCH_FLEET_UPDATES", 128))
+            fn_, fsrc, fdst, fcomm, fvm = build_bench_inputs(fl_scale)
+            rng_q = np.random.default_rng(7)
+            fl_srcs = [
+                int(x) for x in rng_q.integers(0, fn_, size=fl_q)
+            ]
+            fl_queries = [
+                ("sssp" if i % 2 == 0 else "khop", {"source": s})
+                for i, s in enumerate(fl_srcs)
+            ]
+            u_src, u_dst = delta_edges(fl_scale, fl_upd, seed=43)
+            rng_uw = np.random.default_rng(47)
+            u_w = rng_uw.uniform(0.1, 10.0, fl_upd)
+            fl_ops = [("a", int(s), int(d), float(x)) for s, d, x in
+                      zip(u_src, u_dst, u_w)]
+            fl_drain_at = fl_q // 2
+
+            def fleet_run(R, drain):
+                base = build_bench_weighted_fragment(
+                    fsrc, fdst, fcomm, fvm, retain_edge_list=True
+                )
+                frags = [base] + [
+                    replicate_fragment(base) for _ in range(R - 1)
+                ]
+                sessions = [
+                    ServeSession(
+                        f, policy=BatchPolicy(max_batch=8),
+                        dyn=RepackPolicy(
+                            capacity=max(4096, 4 * fl_upd)),
+                    )
+                    for f in frags
+                ]
+                router = FleetRouter(sessions)
+                # warm every (app, batch-shape) runner the run touches
+                for s in fl_srcs[:8]:
+                    router.submit("sssp", {"source": s})
+                    router.submit("khop", {"source": s})
+                router.drain()
+                for r in router.replicas:  # hist/latency = measured
+                    r.latencies, r.served, r.ok = [], 0, 0
+                t0 = time.perf_counter()
+                reqs = run_fleet_script(
+                    router, fl_queries, delta_ops=fl_ops,
+                    ingest_every=16,
+                    drain_at=fl_drain_at if drain else None,
+                    drain_idx=0,
+                    # the offline work: a forced empty-delta repack
+                    # THROUGH the session (counted, adopts the rebuilt
+                    # fragment into the resident workers)
+                    offline=(lambda s: s.ingest([], force_repack=True))
+                    if drain else None,
+                )
+                wall = time.perf_counter() - t0
+                digs = [
+                    q.result.values.tobytes()
+                    if q.result is not None and q.result.ok else b""
+                    for q in reqs
+                ]
+                dropped = sum(1 for q in reqs if q.result is None)
+                return router, reqs, digs, dropped, wall
+
+            FLEET_STATS.reset()
+            _, _, base_digs, base_drop, _ = fleet_run(1, False)
+            router, reqs, digs, dropped, wall = fleet_run(2, True)
+            identical = digs == base_digs
+            # evict -> re-admit drill on replica 0: warm the probe
+            # shape once (the drain's offline repack re-keyed the
+            # runners, an ordinary counted compile), then release the
+            # device buffers and re-admit — the REPEAT of a warmed
+            # query must compile NOTHING (the tenancy zero-replanning
+            # contract: host plan caches and runner caches stay warm
+            # across eviction)
+            sess0 = router.replicas[0].session
+            sess0.submit("sssp", {"source": fl_srcs[0]})
+            sess0.drain()
+            sess0.release_device()
+            sess0.restore_device()
+            with compile_events() as ev:
+                sess0.submit("sssp", {"source": fl_srcs[0]})
+                sess0.drain()
+            readmit_compiles = ev.compiles
+            drain_evs = [e for e in FLEET_STATS.events
+                         if e.get("kind") == "drain"]
+            rejoin_evs = [e for e in FLEET_STATS.events
+                          if e.get("kind") == "rejoin"]
+            per_replica = {}
+            for rkey, s in router.summary(wall)["replicas"].items():
+                per_replica[rkey] = {
+                    "qps": s.get("qps", 0.0), "p50_ms": s["p50_ms"],
+                    "p99_ms": s["p99_ms"], "served": s["served"],
+                    "ok": s["ok"],
+                }
+            fleet_block = {
+                "scale": fl_scale,
+                "replicas": 2,
+                "tenants": 0,
+                "queries": fl_q,
+                "ok": sum(
+                    1 for q in reqs
+                    if q.result is not None and q.result.ok
+                ),
+                "dropped": dropped + base_drop,
+                "drain_at": fl_drain_at,
+                "drained_replica": 0,
+                "drain_wall_s": (
+                    drain_evs[-1]["wall_s"] if drain_evs else 0.0
+                ),
+                "catchup_ops": (
+                    rejoin_evs[-1]["catchup_ops"] if rejoin_evs
+                    else 0
+                ),
+                "updates": fl_upd,
+                "updates_per_s": (
+                    round(fl_upd / wall, 1) if wall > 0 else 0.0
+                ),
+                "fence": router.fence,
+                "byte_identical": identical,
+                "per_replica": per_replica,
+                "evictions": FLEET_STATS.evictions,
+                "readmit_compiles": readmit_compiles,
+            }
+            record["fleet"] = fleet_block
+            _emit_record(record)
+            print(
+                f"[bench] fleet: R=2 drain@{fl_drain_at} "
+                f"identical={identical} dropped={fleet_block['dropped']} "
+                + " ".join(
+                    f"{k}={v['qps']}q/s@p99={v['p99_ms']}ms"
+                    for k, v in per_replica.items()
+                )
+                + f" catchup={fleet_block['catchup_ops']}ops "
+                f"readmit_compiles={readmit_compiles}",
+                file=sys.stderr,
+            )
+            if not identical:
+                fleet_mismatch = (
+                    "drained R=2 results diverged from the undrained "
+                    "R=1 run — the drain/fence changed answers"
+                )
+            elif fleet_block["dropped"]:
+                fleet_mismatch = (
+                    f"{fleet_block['dropped']} dropped quer(ies) — "
+                    "the drain was not zero-downtime"
+                )
+            elif readmit_compiles:
+                fleet_mismatch = (
+                    f"{readmit_compiles} XLA compile(s) after "
+                    "evict -> re-admit — the warm-host-artifact "
+                    "contract broke"
+                )
+        except Exception as e:  # the lane must not cost the bench
+            print(
+                f"[bench] fleet lane failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+
     # superstep-pipelining lane (r9, ROADMAP item 3): serial vs
     # pipelined wall at fnum>=2 with the byte-identity verdict, the
     # modeled hidden-exchange fraction, the boundary-set sizes and the
@@ -1654,6 +1864,13 @@ def main():
         print(
             f"[bench] FATAL: serve_async lane verdict failed: "
             f"{serve_async_mismatch} — see the serve_async block above",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    if fleet_mismatch is not None:
+        print(
+            f"[bench] FATAL: fleet lane verdict failed: "
+            f"{fleet_mismatch} — see the fleet block above",
             file=sys.stderr,
         )
         sys.exit(2)

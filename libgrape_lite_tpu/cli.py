@@ -7,7 +7,12 @@ Flag names mirror the reference gflags catalog
 serving runtime instead (serve/, docs/SERVING.md): load the graph
 once, pump a scripted query stream through the admission queue with
 vmapped multi-source batching, and print one JSON summary line
-(queries, qps, p50/p99 latency, batch-size histogram).
+(queries, qps, p50/p99 latency globally and per app, batch-size
+histogram).  `--replicas R / --tenants ... / --drain_at K` raise the
+serving fleet instead (fleet/, docs/FLEET.md): replica routing
+behind a graph-version fence, HBM-budget tenancy, and a
+zero-downtime mid-stream drain; `--arrival_rate` feeds the stream
+from a wall-clock feeder thread (serve/feeder.py).
 
 `python -m libgrape_lite_tpu.cli lint ...` runs grape-lint
 (analysis/, docs/STATIC_ANALYSIS.md): the AST contract rules R1-R7
@@ -37,6 +42,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--bc_source", default="0")
     p.add_argument("--kcore_k", type=int, default=0)
     p.add_argument("--kclique_k", type=int, default=3)
+    p.add_argument("--khop_k", type=int, default=2,
+                   help="k-hop neighborhood hop bound (models/khop.py; "
+                        "the source comes from --bfs_source)")
     p.add_argument("--cn_source", default="0",
                    help="common_neighbors 2-hop query source vertex")
     p.add_argument("--pr_d", type=float, default=0.85)
@@ -138,6 +146,32 @@ def make_serve_parser() -> argparse.ArgumentParser:
                    choices=["", "off", "warn", "halt", "rollback"],
                    help="per-lane guard policy (breach isolation: a "
                         "poisoned lane fails alone)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="fleet/: serve the graph from R replica "
+                        "sessions behind a least-outstanding front "
+                        "router with a graph-version fence "
+                        "(docs/FLEET.md); 1 keeps the single-session "
+                        "path bit-for-bit")
+    p.add_argument("--drain_at", type=int, default=-1,
+                   help="fleet/: begin draining replica 0 before the "
+                        "K-th query (zero-downtime drain drill — it "
+                        "rejoins after the next ingest barrier, or at "
+                        "stream end); requires --replicas >= 2")
+    p.add_argument("--tenants", default="",
+                   help="fleet/: multi-tenant front — 'by_app' gives "
+                        "each distinct app its own tenant, an integer "
+                        "N round-robins queries over N tenants; "
+                        "tenants share the HBM budget "
+                        "(GRAPE_FLEET_HBM_BYTES) with weighted "
+                        "round-robin fairness and never share a "
+                        "batched dispatch")
+    p.add_argument("--arrival_rate", type=float, default=0.0,
+                   help="threaded admission front (serve/feeder.py): "
+                        "submit the stream at this rate from a feeder "
+                        "thread with real wall-clock arrivals, so "
+                        "--max_wait_ms and priority/deadline "
+                        "scheduling are exercised under load; 0 keeps "
+                        "the deterministic scripted mode")
     p.add_argument("--delta_stream", default="",
                    help="dyn/ live ingest: a delta-op stream file "
                         "('a src dst [w]' / 'd src dst' / 'u src dst "
@@ -339,30 +373,45 @@ def serve_main(argv=None):
         delta_ops = parse_ops_file(
             ns.delta_stream, weighted=weighted, string_id=ns.string_id
         )
+    fleet_mode = ns.replicas > 1 or bool(ns.tenants)
+    if ns.drain_at >= 0 and ns.replicas < 2:
+        sys.exit("serve: --drain_at needs --replicas >= 2 (draining "
+                 "the only replica would drop traffic)")
+    if fleet_mode and ns.arrival_rate:
+        sys.exit("serve: --arrival_rate does not compose with "
+                 "--replicas/--tenants yet")
     spec = LoadGraphSpec(
         directed=ns.directed, weighted=weighted,
         string_id=ns.string_id, edata_dtype=np.float64,
-        retain_edge_list=bool(ns.delta_stream),
+        retain_edge_list=bool(ns.delta_stream) or ns.replicas > 1,
     )
     with timer.phase("load graph"):
         frag = LoadGraph(ns.efile, ns.vfile or None,
                          CommSpec(fnum=ns.fnum), spec)
 
-    dyn = None
-    if ns.delta_stream:
+    def dyn_policy():
+        if not ns.delta_stream:
+            return None
         from libgrape_lite_tpu.dyn import RepackPolicy
 
-        dyn = (
+        return (
             RepackPolicy(threshold=ns.dyn_repack_ratio)
             if ns.dyn_repack_ratio is not None
             else RepackPolicy.from_env()
         )
+
+    policy = BatchPolicy(max_batch=ns.max_batch,
+                         max_wait_s=ns.max_wait_ms / 1e3)
+
+    if fleet_mode:
+        return _serve_fleet(ns, frag, queries, delta_ops, policy,
+                            dyn_policy)
+
     sess = ServeSession(
         frag,
-        policy=BatchPolicy(max_batch=ns.max_batch,
-                           max_wait_s=ns.max_wait_ms / 1e3),
+        policy=policy,
         guard=ns.guard or None,
-        dyn=dyn,
+        dyn=dyn_policy(),
     )
     # --inflight > 1 arms the async pump (serve/pipeline.py): up to W
     # coalesced batches dispatched un-synced, lazy FIFO harvest, and
@@ -370,6 +419,46 @@ def serve_main(argv=None):
     # synchronous loop below bit-for-bit.
     pump = sess.async_pump(window=ns.inflight) if ns.inflight > 1 else None
     t0 = time.perf_counter()
+    if ns.arrival_rate:
+        # threaded admission front (serve/feeder.py): a feeder thread
+        # submits at the asked rate with REAL wall-clock arrival
+        # timestamps while this thread pumps — max_wait_ms and
+        # priority/deadline scheduling genuinely gate under load.
+        # Does not compose with --delta_stream (the deterministic
+        # ingest cadence is pinned by dispatch count, which a
+        # wall-clock feeder cannot reproduce).
+        if delta_ops:
+            sys.exit("serve: --arrival_rate does not compose with "
+                     "--delta_stream")
+        from libgrape_lite_tpu.serve import ArrivalFeeder
+
+        feeder = ArrivalFeeder(
+            sess.submit,
+            # dict form so --max_rounds reaches submit exactly as on
+            # the scripted path
+            [{"app": app_key, "args": {"source": src},
+              "max_rounds": ns.max_rounds or None}
+             for app_key, src in queries],
+            ns.arrival_rate,
+        )
+        results = []
+        feeder.start()
+        while feeder.is_alive() or sess.queue.pending() or (
+            pump is not None and pump.inflight()
+        ):
+            got = (pump.pump() if pump is not None
+                   else sess.pump())
+            results.extend(got)
+            if not got:
+                time.sleep(1e-4)
+        feeder.join()
+        results.extend(
+            pump.drain() if pump is not None else sess.drain()
+        )
+        reqs = feeder.requests
+        wall = time.perf_counter() - t0
+        return _serve_summary(ns, sess, pump, reqs, results, wall,
+                              delta_ops)
     reqs = [
         sess.submit(app_key, {"source": src},
                     max_rounds=ns.max_rounds or None)
@@ -416,34 +505,178 @@ def serve_main(argv=None):
     else:
         results = pump.drain() if pump is not None else sess.drain()
     wall = time.perf_counter() - t0
+    return _serve_summary(ns, sess, pump, reqs, results, wall,
+                          delta_ops)
 
-    lat = sorted(r.latency_s for r in results)
+
+def _serve_fleet(ns, frag, queries, delta_ops, policy, dyn_policy):
+    """The fleet serving path (fleet/, docs/FLEET.md): R replica
+    sessions behind a version-fenced router and/or N tenants under
+    one HBM budget, driven by the deterministic
+    `run_fleet_script` — so a `--replicas 2 --drain_at K` run is
+    byte-identical per query to the plain single-replica run (the
+    smoke in scripts/app_tests.sh cmp's exactly that via
+    --dump_results).  `dyn_policy` is serve_main's own repack-policy
+    factory — ONE copy of that decision, so the fleet run can never
+    quietly use a different policy than the plain run it must match
+    byte-for-byte."""
+    import sys
+    import time
+
+    from libgrape_lite_tpu.fleet import (
+        FLEET_STATS,
+        FleetBudget,
+        FleetManager,
+        FleetRouter,
+        run_fleet_script,
+    )
+    from libgrape_lite_tpu.fragment.mutation import replicate_fragment
+    from libgrape_lite_tpu.serve import ServeSession
+
+    # the summary's fleet counters are a per-run record (the bench
+    # PUMP_STATS discipline): reset the process-global stats first
+    FLEET_STATS.reset()
+
+    def make_session(f):
+        return ServeSession(
+            f, policy=policy, guard=ns.guard or None,
+            dyn=dyn_policy(),
+        )
+
+    frags = [frag] + [
+        replicate_fragment(frag) for _ in range(ns.replicas - 1)
+    ]
+    sessions = [make_session(f) for f in frags]
+    router = (
+        FleetRouter(sessions, window=max(1, ns.inflight))
+        if ns.replicas > 1 else None
+    )
+    target = router if router is not None else sessions[0]
+
+    manager = None
+    tenant_of = None
+    if ns.tenants:
+        manager = FleetManager(FleetBudget())
+        if ns.tenants == "by_app":
+            names = sorted({app for app, _ in queries})
+            tenant_of = lambda i, app: app  # noqa: E731
+        else:
+            try:
+                n_t = max(1, int(ns.tenants))
+            except ValueError:
+                sys.exit(f"serve: --tenants must be 'by_app' or an "
+                         f"integer, got {ns.tenants!r}")
+            names = [f"t{j}" for j in range(n_t)]
+            tenant_of = lambda i, app: f"t{i % n_t}"  # noqa: E731
+        for name in names:
+            manager.add_tenant(name, target)
+
+    fleet_queries = [
+        (app_key, {"source": src}) for app_key, src in queries
+    ]
+    t0 = time.perf_counter()
+    reqs = run_fleet_script(
+        target, fleet_queries, manager=manager, tenant_of=tenant_of,
+        delta_ops=delta_ops, ingest_every=max(1, ns.ingest_every),
+        drain_at=(ns.drain_at if ns.drain_at >= 0 else None),
+        drain_idx=0,
+        # stream-wide limits reach the queue exactly as on the plain
+        # path (a dropped --max_rounds would silently change results)
+        submit_kwargs={"max_rounds": ns.max_rounds or None},
+    )
+    wall = time.perf_counter() - t0
+    results = [q.result for q in reqs if q.result is not None]
+
+    fleet_block = {
+        "replicas": ns.replicas,
+        "tenants": len(manager.tenants) if manager is not None else 0,
+        "fence": router.fence if router is not None else 0,
+        "dropped": len(reqs) - len(results),
+        **FLEET_STATS.snapshot(),
+    }
+    if router is not None:
+        fleet_block["router"] = router.summary(wall)
+    if manager is not None:
+        snap = manager.snapshot()
+        fleet_block["tenant_stats"] = snap["tenants"]
+        fleet_block["budget"] = {
+            "capacity": snap["budget"]["capacity"],
+            "used_bytes": snap["budget"]["used_bytes"],
+        }
+    return _serve_summary(
+        ns, sessions[0], None, reqs, results, wall, delta_ops,
+        fleet_block=fleet_block, sessions=sessions,
+    )
+
+
+def _per_app_latency_ms(results) -> dict:
+    """Per-app p50/p99 latency next to the global one — the fleet
+    bench's per-workload view of a mixed stream."""
+    from libgrape_lite_tpu.serve.queue import latency_summary_ms
+
+    by_app: dict = {}
+    for r in results:
+        by_app.setdefault(r.app_key, []).append(r.latency_s)
+    out = {}
+    for app, lat in sorted(by_app.items()):
+        s = latency_summary_ms(lat)
+        out[app] = {"p50": s["p50_ms"], "p99": s["p99_ms"]}
+    return out
+
+
+def _serve_summary(ns, sess, pump, reqs, results, wall, delta_ops,
+                   fleet_block=None, sessions=None):
+    """Build + print the serve summary record (shared by the plain,
+    feeder and fleet paths).  `sessions` (fleet) merges batch
+    histograms and admission waits across replicas/tenant sessions;
+    otherwise `sess` alone reports."""
+    import json
+    import sys
+
+    from libgrape_lite_tpu.serve.queue import latency_summary_ms
+
+    sessions = sessions or [sess]
+    lat = latency_summary_ms([r.latency_s for r in results])
     ok = sum(1 for r in results if r.ok)
     per_app: dict = {}
     for r in results:
         per_app[r.app_key] = per_app.get(r.app_key, 0) + 1
-    wait_summary = sess.queue.admission_wait_summary()
+    waits = latency_summary_ms(
+        [w for s in sessions for w in s.queue.admission_waits]
+    )
+    batch_hist: dict = {}
+    for s in sessions:
+        for k, v in s.queue.batch_hist.items():
+            batch_hist[k] = batch_hist.get(k, 0) + v
+    cache = {"runner": {"hits": 0, "misses": 0},
+             "pack": sess.cache_stats()["pack"]}
+    for s in sessions:
+        st = s.cache_stats()["runner"]
+        cache["runner"]["hits"] += st["hits"]
+        cache["runner"]["misses"] += st["misses"]
     record = {
         "queries": len(results),
         "ok": ok,
         "failed": len(results) - ok,
         "wall_s": round(wall, 4),
         "qps": round(len(results) / wall, 2) if wall > 0 else 0.0,
-        "p50_ms": round(1e3 * lat[len(lat) // 2], 3),
-        "p99_ms": round(
-            1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+        "p50_ms": lat["p50_ms"],
+        "p99_ms": lat["p99_ms"],
         "max_batch": ns.max_batch,
         "inflight": ns.inflight,
         "batch_hist": {
-            str(k): v for k, v in sorted(sess.queue.batch_hist.items())
+            str(k): v for k, v in sorted(batch_hist.items())
         },
         # per-request submit->dispatch wait (serve/queue.py): the
         # admission-latency half of the p99 story, next to batch_hist
         "admission_wait_ms": {
-            "p50": wait_summary["p50_ms"], "p99": wait_summary["p99_ms"],
+            "p50": waits["p50_ms"], "p99": waits["p99_ms"],
         },
         "apps": per_app,
-        "cache": sess.cache_stats(),
+        # per-app latency split next to the global p50/p99 (a mixed
+        # stream's per-workload tails diverge — sssp vs khop)
+        "per_app_ms": _per_app_latency_ms(results),
+        "cache": cache,
     }
     if pump is not None:
         from libgrape_lite_tpu.serve import PUMP_STATS
@@ -457,17 +690,21 @@ def serve_main(argv=None):
         # the same field names as bench.py's schema-checked dyn block
         # (scripts/check_bench_schema.py _DYN), so both surfaces
         # validate against one declaration
+        ingested = sum(s.stats["ingested_ops"] for s in sessions)
         record["dyn"] = {
-            "ingested": sess.stats["ingested_ops"],
-            "overlay_applies": sess.stats["overlay_applies"],
-            "repack_count": sess.stats["repacks"],
+            "ingested": ingested,
+            "overlay_applies": sum(
+                s.stats["overlay_applies"] for s in sessions
+            ),
+            "repack_count": sum(s.stats["repacks"] for s in sessions),
             "queries": len(results),
             "queries_ok": ok,
             "updates_per_s": (
-                round(sess.stats["ingested_ops"] / wall, 2)
-                if wall > 0 else 0.0
+                round(ingested / wall, 2) if wall > 0 else 0.0
             ),
         }
+    if fleet_block is not None:
+        record["fleet"] = fleet_block
     if ns.dump_results:
         # submit-order identity surface: one line per query with a
         # digest of its assembled values — byte-comparable across

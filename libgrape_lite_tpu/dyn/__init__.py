@@ -21,6 +21,7 @@ from libgrape_lite_tpu.dyn.incremental import (
 from libgrape_lite_tpu.dyn.ingest import (
     DeltaOverlay,
     DynGraph,
+    broadcast_ingest,
     overlay_state_entries,
 )
 from libgrape_lite_tpu.dyn.repack import RepackPolicy, repack_fragment
@@ -32,6 +33,7 @@ __all__ = [
     "DeltaOverlay",
     "DynGraph",
     "RepackPolicy",
+    "broadcast_ingest",
     "incremental_plan",
     "overlay_state_entries",
     "parse_ops_file",

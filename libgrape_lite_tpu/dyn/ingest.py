@@ -320,6 +320,19 @@ class DynGraph:
         }
 
 
+def broadcast_ingest(targets, ops, *, force_repack: bool = False) -> list:
+    """Apply ONE delta chunk to every target (DynGraphs or dyn-enabled
+    ServeSessions) in order — the fleet router's replica broadcast
+    (fleet/router.py wraps this behind its graph-version fence).  The
+    ops list is materialised once so a generator cannot feed replica
+    0 a different stream than replica 1; per-target reports return in
+    target order."""
+    ops = list(ops)
+    return [
+        t.ingest(ops, force_repack=force_repack) for t in targets
+    ]
+
+
 def overlay_state_entries(frag, direction: str, weight_dtype=None,
                           prefix: Optional[str] = None) -> Dict:
     """Helper for app init_state: the fragment's overlay entries, or {}

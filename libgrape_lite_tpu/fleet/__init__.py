@@ -1,0 +1,83 @@
+"""fleet/ — the multi-tenant serving fleet (ROADMAP item 2b/2c).
+
+One process, N resident (graph x app) sessions, R replicas, one HBM
+budget:
+
+* **budget.py** — price each session's device footprint from the
+  ledgers that already exist (CSR bytes, pack/spgemm plan streams,
+  dyn overlay planes, resident runner buffers) and drive
+  admission/eviction with a cost-weighted LRU under
+  GRAPE_FLEET_HBM_BYTES; every decision recorded in `FLEET_STATS`.
+* **tenancy.py** — `FleetManager`: N tenants with weighted
+  round-robin fairness feeding their sessions, per-tenant breach
+  isolation (tenants never share a batched dispatch), and
+  evict/re-admit through `ServeSession.release_device` /
+  `restore_device` — re-admission is zero pack re-planning and zero
+  XLA recompiles (the host plan caches stay warm).
+* **router.py / drain.py** — `FleetRouter`: the same graph resident
+  R times behind a least-outstanding front, dyn ingest broadcast
+  behind a graph-version fence (no result may ever mix versions —
+  violations are loud), and `drain(replica)` on the async pump's
+  quiesce barrier: stop routing, finish every admitted query, run
+  repack/reshard/ingest offline, rejoin at the fenced version — zero
+  dropped queries, byte-identical results.
+
+docs/FLEET.md is the user guide; the CLI surface is
+`python -m libgrape_lite_tpu.cli serve --tenants ... --replicas R
+--drain_at K`, and bench.py's `fleet` block reports sustained
+qps@p99 PER REPLICA with concurrent ingest and a mid-run drain.
+"""
+
+from libgrape_lite_tpu.fleet.budget import (
+    FLEET_STATS,
+    FleetBudget,
+    Footprint,
+    fragment_bytes,
+    overlay_bytes,
+    plan_stream_bytes,
+    runner_bytes,
+    session_footprint,
+    target_footprint,
+)
+from libgrape_lite_tpu.fleet.drain import (
+    begin_drain,
+    drain_replica,
+    rejoin,
+)
+from libgrape_lite_tpu.fleet.router import (
+    FenceError,
+    FenceViolationError,
+    FleetRouter,
+    Replica,
+    run_fleet_script,
+)
+from libgrape_lite_tpu.fleet.tenancy import (
+    FleetAdmissionError,
+    FleetManager,
+    Tenant,
+    TenantTicket,
+)
+
+__all__ = [
+    "FLEET_STATS",
+    "FenceError",
+    "FenceViolationError",
+    "FleetAdmissionError",
+    "FleetBudget",
+    "FleetManager",
+    "FleetRouter",
+    "Footprint",
+    "Replica",
+    "Tenant",
+    "TenantTicket",
+    "begin_drain",
+    "drain_replica",
+    "fragment_bytes",
+    "overlay_bytes",
+    "plan_stream_bytes",
+    "rejoin",
+    "run_fleet_script",
+    "runner_bytes",
+    "session_footprint",
+    "target_footprint",
+]

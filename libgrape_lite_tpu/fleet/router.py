@@ -1,0 +1,329 @@
+"""Replica routing: one graph resident R times, one front router.
+
+The same graph loaded as R replica `ServeSession`s (each with its own
+fragment copy — `fragment.mutation.replicate_fragment` rebuilds from
+the retained edge list, deterministically, so replicas answer
+byte-identically) behind a front router:
+
+* **least-outstanding routing** — `submit` picks the routable replica
+  with the fewest outstanding queries (ties broken by replica index,
+  so scripted streams stay deterministic) and records per-replica
+  served/ok/latency accounting (`Replica.summary` — the per-replica
+  qps@p99 the ROADMAP names as the target bench).
+
+* **graph-version fence** — the router carries a fence version,
+  bumped at every `ingest`.  An ingest is a fleet-wide barrier: every
+  routable replica drains (its in-flight queries land on the
+  pre-delta graph), then applies the SAME delta chunk and adopts the
+  new fence.  A query is only ever routed to a replica whose version
+  matches the fence, and a routable replica at the wrong version is a
+  LOUD `FenceViolationError` at both submit and pump time — no result
+  may ever mix versions.
+
+* **drain** (fleet/drain.py) — `drain(replica)` rides the async
+  pump's quiesce barrier: stop routing, finish every admitted query
+  (zero drops), run the offline work (repack/reshard/catch-up
+  ingest), rejoin at the fenced version.
+
+Each replica gets an `AsyncServePump` (window=1 by default — the
+synchronous discipline, byte-identical by the r12 pin — deeper
+windows compose) whose quiesce barrier IS the drain primitive.
+
+docs/FLEET.md is the user guide; the CLI surface is
+`serve --replicas R [--drain_at K]`.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from libgrape_lite_tpu import obs
+from libgrape_lite_tpu.fleet.budget import FLEET_STATS
+
+
+class FenceError(RuntimeError):
+    """No routable replica is available at the current fence."""
+
+
+class FenceViolationError(RuntimeError):
+    """A routable replica's graph version diverged from the fence —
+    dispatching to it could mix results across graph versions."""
+
+
+class Replica:
+    """One resident copy of the graph: its session, pump, version,
+    and accounting."""
+
+    def __init__(self, idx: int, session, window: int = 1):
+        self.idx = idx
+        self.session = session
+        self.pump = session.async_pump(window=window)
+        self.version = 0
+        self.routable = True
+        self.outstanding = 0
+        self.catchup: List[tuple] = []  # (fence, ops, force) missed
+        self.served = 0
+        self.ok = 0
+        self.latencies: List[float] = []
+        self.drains = 0
+
+    def summary(self, wall_s: Optional[float] = None) -> dict:
+        from libgrape_lite_tpu.serve.queue import latency_summary_ms
+
+        lat = latency_summary_ms(self.latencies)
+        out = {
+            "served": self.served,
+            "ok": self.ok,
+            "p50_ms": lat["p50_ms"],
+            "p99_ms": lat["p99_ms"],
+            "version": self.version,
+            "drains": self.drains,
+        }
+        if wall_s:
+            out["qps"] = round(self.served / wall_s, 2)
+        return out
+
+
+class FleetRouter:
+    """Front router over R replica sessions (see module docstring)."""
+
+    def __init__(self, sessions, *, window: int = 1):
+        if not sessions:
+            raise ValueError("router needs at least one replica session")
+        self.replicas = [
+            Replica(i, s, window) for i, s in enumerate(sessions)
+        ]
+        self.fence = 0
+        self._live: List[tuple] = []  # (QueryRequest, Replica)
+        self.stats = {"routed": 0, "ingests": 0, "drains": 0}
+
+    # ---- routing ----------------------------------------------------------
+
+    def _routable(self) -> List[Replica]:
+        out = [r for r in self.replicas if r.routable]
+        for r in out:
+            self._check_fence(r)
+        return out
+
+    def _check_fence(self, r: Replica) -> None:
+        if r.version != self.fence:
+            raise FenceViolationError(
+                f"replica {r.idx} is routable at graph version "
+                f"{r.version} but the fence is {self.fence} — "
+                "results would mix graph versions"
+            )
+
+    def submit(self, app_key: str, args: dict | None = None, **kw):
+        """Route one query to the least-outstanding routable replica
+        (fence-checked) and return its QueryRequest."""
+        cands = self._routable()
+        if not cands:
+            raise FenceError(
+                "no routable replica (all draining?) — rejoin one "
+                "before submitting"
+            )
+        pick = min(cands, key=lambda r: (r.outstanding, r.idx))
+        req = pick.session.submit(app_key, args, **kw)
+        pick.outstanding += 1
+        self._live.append((req, pick))
+        self.stats["routed"] += 1
+        tr = obs.tracer()
+        if tr.enabled:
+            obs.metrics().gauge(
+                f"grape_fleet_outstanding_r{pick.idx}"
+            ).set(pick.outstanding)
+        return req
+
+    def _collect(self) -> None:
+        """Bind completed requests back to their replica accounting."""
+        still = []
+        for req, r in self._live:
+            if req.done:
+                r.outstanding -= 1
+                r.served += 1
+                r.ok += int(bool(req.result.ok))
+                r.latencies.append(req.result.latency_s)
+            else:
+                still.append((req, r))
+        self._live = still
+
+    # ---- driving ----------------------------------------------------------
+
+    def pump(self) -> List:
+        """One pass: pump every routable replica once (fence-checked),
+        collect accounting, return this step's results.  Each
+        replica's interval lands on its own trace row
+        (tracer.replica_tid) when obs is armed."""
+        out = []
+        tr = obs.tracer()
+        for r in self._routable():
+            with tr.span("fleet_pump", replica=r.idx,
+                         outstanding=r.outstanding) as sp:
+                got = r.pump.pump(force=True)
+            if tr.enabled and got:
+                tr.emit_span_raw(
+                    "fleet_replica", t0_ns=sp.t0_ns, dur_ns=sp.dur_ns,
+                    tid=tr.replica_tid(r.idx), replica=r.idx,
+                    results=len(got),
+                )
+            out.extend(got)
+        self._collect()
+        return out
+
+    def drain(self) -> List:
+        """Drain every ROUTABLE replica's queue + window (a draining
+        replica is finished separately by fleet/drain.py)."""
+        out = []
+        tr = obs.tracer()
+        for r in self._routable():
+            with tr.span("fleet_pump", replica=r.idx,
+                         outstanding=r.outstanding) as sp:
+                got = r.pump.drain()
+            if tr.enabled and got:
+                tr.emit_span_raw(
+                    "fleet_replica", t0_ns=sp.t0_ns, dur_ns=sp.dur_ns,
+                    tid=tr.replica_tid(r.idx), replica=r.idx,
+                    results=len(got),
+                )
+            out.extend(got)
+        self._collect()
+        return out
+
+    # ---- dyn ingest: the version fence -------------------------------------
+
+    def ingest(self, ops, *, force_repack: bool = False) -> dict:
+        """Broadcast one delta chunk behind the version fence.
+
+        Barrier first: every routable replica drains, so every query
+        admitted before this call lands on the pre-delta graph —
+        queries and ingests interleave identically at any replica
+        count, which is what makes an R=2 run byte-identical to the
+        R=1 run (the drain drill's identity argument).  Then the
+        fence bumps, every routable replica applies the SAME ops
+        (dyn/ broadcast — overlay-only ingests stay zero-recompile
+        per replica), and draining replicas log the chunk for their
+        offline catch-up."""
+        from libgrape_lite_tpu.dyn.ingest import broadcast_ingest
+
+        self.drain()
+        self.fence += 1
+        ops = list(ops)
+        live = [r for r in self.replicas if r.routable]
+        reports = broadcast_ingest(
+            [r.session for r in live], ops, force_repack=force_repack
+        )
+        for r in self.replicas:
+            if r.routable:
+                r.version = self.fence
+            else:
+                r.catchup.append((self.fence, ops, force_repack))
+        self.stats["ingests"] += 1
+        tr = obs.tracer()
+        if tr.enabled:
+            tr.instant(
+                "fleet_ingest", fence=self.fence, ops=len(ops),
+                applied=len(reports),
+                deferred=len(self.replicas) - len(reports),
+            )
+        return {
+            "fence": self.fence,
+            "applied_replicas": len(reports),
+            "reports": reports,
+        }
+
+    # ---- drain lifecycle (fleet/drain.py) ---------------------------------
+
+    def begin_drain(self, idx: int, *, offline=None) -> dict:
+        from libgrape_lite_tpu.fleet.drain import begin_drain
+
+        return begin_drain(self, idx, offline=offline)
+
+    def rejoin(self, idx: int) -> dict:
+        from libgrape_lite_tpu.fleet.drain import rejoin
+
+        return rejoin(self, idx)
+
+    def drain_replica(self, idx: int, *, offline=None) -> dict:
+        from libgrape_lite_tpu.fleet.drain import drain_replica
+
+        return drain_replica(self, idx, offline=offline)
+
+    def summary(self, wall_s: Optional[float] = None) -> dict:
+        return {
+            "fence": self.fence,
+            "stats": dict(self.stats),
+            "replicas": {
+                f"r{r.idx}": r.summary(wall_s) for r in self.replicas
+            },
+        }
+
+
+def run_fleet_script(target, queries, *, manager=None, tenant_of=None,
+                     delta_ops=None, ingest_every: int = 8,
+                     drain_at: Optional[int] = None,
+                     drain_idx: int = 0, offline=None,
+                     submit_kwargs: Optional[dict] = None) -> List:
+    """The deterministic fleet driver shared by the CLI, bench.py and
+    the tests: submit `queries` ([(app_key, args)] in order) in
+    groups of `ingest_every`, complete each group (a fleet-wide
+    barrier), then broadcast the next delta chunk — so the
+    query <-> graph-version interleave (and therefore every result
+    byte) is identical at ANY replica count, window depth or tenant
+    split.  `drain_at` begins draining replica `drain_idx` before
+    that query index is submitted; the replica rejoins after the NEXT
+    ingest barrier (its catch-up log is then non-trivial) or at the
+    end of the stream.  Returns the tickets/requests in submit order.
+
+    `target` is a FleetRouter or a bare ServeSession; with `manager`,
+    submissions go through the tenancy front (`tenant_of(i, app_key)`
+    names query i's tenant) and completion runs the WRR pump.
+    `submit_kwargs` (e.g. {"max_rounds": 3, "guard": "halt"}) rides on
+    EVERY submit, so stream-wide limits reach the underlying queue
+    exactly as they do on the plain serve path."""
+    delta_ops = list(delta_ops or [])
+    submit_kwargs = dict(submit_kwargs or {})
+    router = target if hasattr(target, "replicas") else None
+    n_groups = max(1, -(-len(queries) // max(1, ingest_every)))
+    chunk = -(-len(delta_ops) // n_groups) if delta_ops else 0
+    oi = 0
+    draining = False
+
+    def complete():
+        if manager is not None:
+            manager.drain()
+        elif router is not None:
+            router.drain()
+        else:
+            target.drain()
+
+    reqs = []
+    for i, (app_key, args) in enumerate(queries):
+        if drain_at is not None and i == drain_at and router is not None:
+            complete()  # the manager lane must be empty before we stop
+            router.begin_drain(drain_idx, offline=offline)
+            draining = True
+        if manager is not None:
+            reqs.append(
+                manager.submit(tenant_of(i, app_key), app_key, args,
+                               **submit_kwargs)
+            )
+        else:
+            reqs.append(target.submit(app_key, args, **submit_kwargs))
+        if (i + 1) % max(1, ingest_every) == 0:
+            complete()
+            if oi < len(delta_ops):
+                ingest = (router or target).ingest
+                ingest(delta_ops[oi:oi + chunk])
+                oi += chunk
+                if draining:
+                    router.rejoin(drain_idx)
+                    draining = False
+    complete()
+    while oi < len(delta_ops):
+        ingest = (router or target).ingest
+        ingest(delta_ops[oi:oi + chunk])
+        oi += chunk
+    if draining:
+        router.rejoin(drain_idx)
+    complete()
+    return reqs

@@ -1,0 +1,302 @@
+"""Multi-tenant sessions under one budget: fairness, evict, re-admit.
+
+A tenant is one (graph x app-mix) serving principal: it owns a
+`ServeSession` (or shares a `FleetRouter` of replica sessions) plus
+its own admission lane, fairness weight, and accounting.  The
+`FleetManager` multiplexes N tenants over one process:
+
+* **Admission lane + weighted round-robin fairness** — `submit`
+  enqueues a `TenantTicket` on the tenant's own pending lane;
+  `forward_round` moves tickets into the underlying session queues in
+  WRR order (ceil(weight) tickets per tenant per cycle, insertion
+  order within a cycle), so a tenant with a deep backlog can never
+  starve a light one: any tenant with pending work is visited every
+  cycle (the starvation bound tests/test_fleet.py pins).  Forwarded
+  requests carry `tenant=` so the session compat key never coalesces
+  two tenants into one batched dispatch — one tenant's poisoned lane
+  cannot fail a batchmate tenant (breach isolation is structural, and
+  pinned).
+
+* **HBM-budget tenancy** — on first use (and on every use after an
+  eviction) a tenant's priced footprint (fleet/budget.py) is admitted
+  under the shared `FleetBudget`; when the budget must make room it
+  evicts cost-weighted-LRU victims through
+  `ServeSession.release_device` — device buffers freed, every host
+  artifact (pack-plan caches, compiled runners, v3 disk cache) kept
+  warm, so the victim's next use re-places buffers with ZERO pack
+  re-planning and ZERO XLA recompiles.  Every decision lands in
+  FLEET_STATS, never silent.
+
+docs/FLEET.md is the user guide; the CLI surface is
+`serve --tenants by_app|N`.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+from libgrape_lite_tpu import obs
+from libgrape_lite_tpu.fleet.budget import (
+    FLEET_STATS,
+    FleetBudget,
+    target_footprint,
+)
+
+
+class FleetAdmissionError(RuntimeError):
+    """The budget rejected a tenant and nothing could be evicted."""
+
+
+class TenantTicket:
+    """One submitted-but-possibly-not-yet-forwarded query.  Once the
+    WRR front forwards it, `request` binds the underlying
+    QueryRequest and `result` proxies its outcome."""
+
+    __slots__ = ("tenant", "app_key", "args", "kwargs", "request")
+
+    def __init__(self, tenant: str, app_key: str, args: dict,
+                 kwargs: dict):
+        self.tenant = tenant
+        self.app_key = app_key
+        self.args = args
+        self.kwargs = kwargs
+        self.request = None  # QueryRequest once forwarded
+
+    @property
+    def forwarded(self) -> bool:
+        return self.request is not None
+
+    @property
+    def done(self) -> bool:
+        return self.request is not None and self.request.done
+
+    @property
+    def result(self):
+        return None if self.request is None else self.request.result
+
+
+class Tenant:
+    """One serving principal: its target (session or router), weight,
+    pending lane, and accounting."""
+
+    def __init__(self, name: str, target, weight: float = 1.0):
+        self.name = name
+        self.target = target
+        self.weight = float(weight)
+        self.pending = deque()  # TenantTickets not yet forwarded
+        self.tickets: List[TenantTicket] = []  # every ticket, in order
+        self.admitted = False
+        self.stats = {
+            "submitted": 0, "forwarded": 0, "completed": 0,
+            "ok": 0, "failed": 0, "readmits": 0,
+        }
+
+    @property
+    def evictable(self) -> bool:
+        """Routers are never evicted by the manager — their replicas
+        are hot by definition (drain/ is their lifecycle surface)."""
+        return hasattr(self.target, "release_device")
+
+    def latencies(self) -> List[float]:
+        return [
+            t.result.latency_s for t in self.tickets
+            if t.done and t.result.latency_s
+        ]
+
+
+class FleetManager:
+    """N tenants, one budget, one process (see module docstring)."""
+
+    def __init__(self, budget: Optional[FleetBudget] = None):
+        self.budget = budget or FleetBudget()
+        self.tenants: Dict[str, Tenant] = {}
+        self.forward_order: List[str] = []  # tenant name per forward
+
+    def add_tenant(self, name: str, target, *,
+                   weight: float = 1.0) -> Tenant:
+        """Register a tenant over `target` (a ServeSession of its own,
+        a session SHARED with other tenants — the budget bills the
+        fragment once — or a FleetRouter).  Admission under the budget
+        is deferred to first use, so adding N tenants never thrashes."""
+        if name in self.tenants:
+            raise ValueError(f"duplicate tenant {name!r}")
+        t = Tenant(name, target, weight)
+        self.tenants[name] = t
+        return t
+
+    # ---- budget integration ----------------------------------------------
+
+    def _evict_cb(self, victim: str) -> None:
+        """Release the victim's device footprint (called by the
+        budget mid-admission).  A fragment shared with another
+        RESIDENT tenant is left placed — only the victim's private
+        buffers go."""
+        t = self.tenants[victim]
+        frag = getattr(t.target, "fragment", None)
+        shared = any(
+            getattr(o.target, "fragment", None) is frag
+            and o.admitted and o.name != victim
+            for o in self.tenants.values()
+        ) if frag is not None else False
+        t.target.release_device(release_fragment=not shared)
+        t.admitted = False
+        if obs.tracer().enabled:
+            obs.metrics().counter("grape_fleet_evictions_total").inc()
+
+    def ensure_resident(self, name: str) -> None:
+        """Admit (or re-admit) a tenant before its work dispatches.
+        A re-admission restores the device arrays from the warm host
+        artifacts — zero re-planning, zero recompiles — and is
+        counted in both the tenant stats and FLEET_STATS."""
+        t = self.tenants[name]
+        if t.admitted and getattr(t.target, "resident", True):
+            self.budget.touch(name)
+            return
+        was_evicted = t.admitted is False and t.stats["forwarded"] > 0
+        # decide FIRST, place buffers second: footprints price from
+        # host twins, so the decision needs no device arrays — and a
+        # reject must not leave the tenant's fragment re-placed in
+        # HBM (the exact over-budget state the budget exists to
+        # prevent)
+        decision = self.budget.admit(
+            name, target_footprint(t.target), weight=t.weight,
+            evictable=t.evictable, evict=self._evict_cb,
+        )
+        if not decision["admitted"]:
+            raise FleetAdmissionError(
+                f"tenant {name!r} rejected: {decision['reason']} "
+                f"(asked {decision['asked_bytes']}B, used "
+                f"{decision['used_bytes']}B of {decision['capacity']}B)"
+            )
+        restore = getattr(t.target, "restore_device", None)
+        if restore is not None:
+            restore()
+        t.admitted = True
+        if was_evicted:
+            t.stats["readmits"] += 1
+            FLEET_STATS._record({"kind": "tenant_readmit", "name": name})
+        if obs.tracer().enabled:
+            obs.metrics().gauge("grape_fleet_resident_bytes").set(
+                self.budget.used_bytes()
+            )
+
+    # ---- admission front + fairness ---------------------------------------
+
+    def submit(self, tenant: str, app_key: str,
+               args: dict | None = None, **kwargs) -> TenantTicket:
+        t = self.tenants[tenant]
+        ticket = TenantTicket(tenant, app_key, dict(args or {}), kwargs)
+        t.pending.append(ticket)
+        t.tickets.append(ticket)
+        t.stats["submitted"] += 1
+        return ticket
+
+    def _forward(self, t: Tenant, ticket: TenantTicket) -> None:
+        self.ensure_resident(t.name)
+        self.budget.touch(t.name)
+        ticket.request = t.target.submit(
+            ticket.app_key, ticket.args, tenant=t.name,
+            **ticket.kwargs,
+        )
+        t.stats["forwarded"] += 1
+        self.forward_order.append(t.name)
+
+    def forward_round(self) -> int:
+        """One WRR cycle: every tenant with pending work forwards up
+        to ceil(weight) tickets, in tenant-insertion order.  Returns
+        the number forwarded (0 = nothing pending anywhere)."""
+        n = 0
+        for t in self.tenants.values():
+            quota = max(1, int(-(-t.weight // 1)))
+            while quota > 0 and t.pending:
+                self._forward(t, t.pending.popleft())
+                quota -= 1
+                n += 1
+        return n
+
+    def _targets(self) -> List:
+        """Unique underlying targets (tenants may share a session or a
+        router — pump each exactly once per step)."""
+        seen, out = set(), []
+        for t in self.tenants.values():
+            if id(t.target) not in seen:
+                seen.add(id(t.target))
+                out.append(t.target)
+        return out
+
+    def _account(self, results) -> None:
+        for t in self.tenants.values():
+            done = sum(1 for tk in t.tickets if tk.done)
+            new = done - t.stats["completed"]
+            if new:
+                t.stats["completed"] = done
+                t.stats["ok"] = sum(
+                    1 for tk in t.tickets if tk.done and tk.result.ok
+                )
+                t.stats["failed"] = t.stats["completed"] - t.stats["ok"]
+
+    def pump(self) -> List:
+        """One fleet step: a WRR forward cycle, then one pump pass
+        over every distinct target.  Returns this step's results."""
+        self.forward_round()
+        out = []
+        for target in self._targets():
+            out.extend(target.pump(force=True)
+                       if _takes_force(target) else target.pump())
+        self._account(out)
+        return out
+
+    def drain(self) -> List:
+        """Forward + pump until every tenant lane and every target
+        queue is empty.  Every pending ticket forwards first (WRR
+        cycle by cycle — the queue ORDER is the fairness decision),
+        then the targets drain: same-tenant requests coalesce into
+        batches while a deep backlog still cannot push another
+        tenant's work behind it."""
+        out = []
+        while any(t.pending for t in self.tenants.values()) or any(
+            _target_busy(tg) for tg in self._targets()
+        ):
+            while self.forward_round():
+                pass
+            for target in self._targets():
+                out.extend(target.drain())
+            self._account(out)
+        return out
+
+    def snapshot(self) -> dict:
+        from libgrape_lite_tpu.serve.queue import latency_summary_ms
+
+        per_tenant = {}
+        for t in self.tenants.values():
+            lat = latency_summary_ms(t.latencies())
+            per_tenant[t.name] = {
+                **t.stats,
+                "weight": t.weight,
+                "resident": bool(
+                    t.admitted and getattr(t.target, "resident", True)
+                ),
+                "p50_ms": lat["p50_ms"],
+                "p99_ms": lat["p99_ms"],
+            }
+        return {
+            "tenants": per_tenant,
+            "budget": self.budget.snapshot(),
+            "fleet": FLEET_STATS.snapshot(),
+        }
+
+
+def _takes_force(target) -> bool:
+    """ServeSession.pump forwards **kw to queue.pump(force=...);
+    FleetRouter.pump takes no arguments."""
+    return not hasattr(target, "replicas")
+
+
+def _target_busy(target) -> bool:
+    if hasattr(target, "replicas"):
+        return any(
+            r.session.queue.pending() or r.pump.inflight()
+            for r in target.replicas
+        )
+    return bool(target.queue.pending())

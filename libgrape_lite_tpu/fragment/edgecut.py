@@ -287,6 +287,46 @@ class ShardedEdgecutFragment:
         gids = self.vertex_map.id_parser.generate(fid, lid)
         return self.vertex_map.get_oid(gids)
 
+    # ---- device residency (fleet/ eviction, docs/FLEET.md) ----
+
+    def release_device(self) -> bool:
+        """Evict: delete the stacked device arrays and drop `dev`.
+        Every host artifact survives — host CSRs, vertex map, the
+        per-fragment pack-plan cache weak-keyed on THIS object — so
+        `restore_device` re-places byte-identical content with zero
+        pack re-planning.  Returns False when already released."""
+        if self.dev is None:
+            return False
+        self._dev_meta = (self.dev.total_vnum, self.dev.total_enum)
+        seen = set()
+        for leaf in jax.tree_util.tree_leaves(self.dev):
+            if leaf is None or id(leaf) in seen:
+                continue  # undirected ie aliases oe: delete once
+            seen.add(id(leaf))
+            delete = getattr(leaf, "delete", None)
+            if callable(delete):
+                try:
+                    delete()
+                except Exception:
+                    pass  # committed/donated buffers: GC frees them
+        self.dev = None
+        return True
+
+    def restore_device(self) -> bool:
+        """Re-admission: rebuild and place the device arrays from the
+        host CSRs (the build is deterministic, so the content is
+        byte-identical to the evicted arrays).  Returns False when
+        already resident."""
+        if self.dev is not None:
+            return False
+        total_vnum, total_enum = self._dev_meta
+        self.dev = self._device_put(
+            self.comm_spec, self.vertex_map, self.host_oe,
+            self.host_ie, self.vp, self.directed, total_vnum,
+            total_enum,
+        )
+        return True
+
     # ---- construction ----
 
     @classmethod

@@ -199,6 +199,16 @@ def _build_edgecut(comm_spec, oids, src, dst, w, directed, spec):
     return _validate_load(frag)
 
 
+def replicate_fragment(frag: ShardedEdgecutFragment) -> ShardedEdgecutFragment:
+    """A fresh, content-identical sharded fragment built from `frag`'s
+    retained host edge list — an EMPTY mutation through the rebuild
+    machinery, so the replica gets its own host CSRs and device
+    arrays (fleet/ replica routing: each replica must repack/reshard
+    independently while siblings keep serving) while the deterministic
+    build keeps results byte-identical across replicas."""
+    return BasicFragmentMutator().mutate(frag)
+
+
 def parse_delta_efile(path: str, weighted: bool, mutator: BasicFragmentMutator,
                       directed: bool) -> None:
     with open(path) as f:

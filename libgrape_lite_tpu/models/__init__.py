@@ -42,6 +42,7 @@ from libgrape_lite_tpu.models.triangle_count import (
     CommonNeighbors,
     TriangleCount,
 )
+from libgrape_lite_tpu.models.khop import KHopNeighborhood
 from libgrape_lite_tpu.models.auto_apps import (
     BFSAuto,
     PageRankAuto,
@@ -123,4 +124,7 @@ APP_REGISTRY = {
     # common_neighbors is the serve-able 2-hop point query
     "triangle_count": TriangleCount,
     "common_neighbors": CommonNeighbors,
+    # k-hop neighborhood extraction (models/khop.py): the
+    # serve-routable sampling workload — ROADMAP 5c one notch
+    "khop": KHopNeighborhood,
 }

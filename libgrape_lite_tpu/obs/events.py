@@ -33,10 +33,12 @@ from typing import Any, Dict
 # tid rows: host threads count up from 0; per-fragment tracks live in
 # their own band so a late-spawned writer thread can never collide with
 # a fragment row; serve/ per-query lane tracks get a band of their own
-# above that (both bands restate host intervals, so the span rollup
-# skips everything >= FRAG_TID_BASE)
+# above that, and fleet/ per-replica tracks a band above THAT (all
+# three bands restate host intervals, so the span rollup skips
+# everything >= FRAG_TID_BASE)
 FRAG_TID_BASE = 1000
 LANE_TID_BASE = 2000
+REPLICA_TID_BASE = 3000
 
 #: keys every exported event must carry (tests/test_obs.py pins these
 #: against the files the exporters actually write)

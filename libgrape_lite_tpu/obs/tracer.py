@@ -204,6 +204,21 @@ class Tracer:
                     self._meta_rows.append((tid, f"lane/{lane}"))
         return tid
 
+    def replica_tid(self, replica: int) -> int:
+        """The per-replica track row for fleet/ routing: each
+        replica's dispatch intervals render as their own Perfetto row
+        (attribution across the replica set, like lane rows across a
+        batch; excluded from the span rollup the same way)."""
+        from libgrape_lite_tpu.obs.events import REPLICA_TID_BASE
+
+        tid = REPLICA_TID_BASE + int(replica)
+        if tid not in self._tids:
+            with self._lock:
+                if tid not in self._tids:
+                    self._tids[tid] = tid
+                    self._meta_rows.append((tid, f"replica/{replica}"))
+        return tid
+
     # ---- emitters --------------------------------------------------------
 
     def span(self, name: str, **args):

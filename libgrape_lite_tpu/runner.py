@@ -36,6 +36,7 @@ class QueryArgs:
     bc_source: int | str = 0
     kcore_k: int = 0
     kclique_k: int = 3
+    khop_k: int = 2  # k-hop neighborhood hop bound (models/khop.py)
     cn_source: int | str = 0  # common_neighbors 2-hop query source
     pr_d: float = 0.85
     pr_mr: int = 10
@@ -93,6 +94,10 @@ def build_query_kwargs(app_name: str, args: QueryArgs) -> dict:
         return {"degree_threshold": args.degree_threshold}
     if app_name == "common_neighbors":
         return {"source": _coerce_source(args.cn_source, args.string_id)}
+    if app_name == "khop":
+        # the hop bound is a constructor hyperparameter (run_app bakes
+        # it into the app); the per-query arg is the source alone
+        return {"source": _coerce_source(args.bfs_source, args.string_id)}
     if app_name.startswith("cdlp"):
         return {"max_round": args.cdlp_mr}
     return {}
@@ -126,7 +131,8 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
             f"unknown application {name!r}; known: {sorted(APP_REGISTRY)}"
         )
     app_cls = APP_REGISTRY[name]
-    app = app_cls()
+    # khop's hop bound is a trace-key hyperparameter, not a query arg
+    app = app_cls(k=args.khop_k) if name == "khop" else app_cls()
 
     if comm_spec is None:
         comm_spec = CommSpec(fnum=args.fnum)

@@ -25,6 +25,7 @@ queries/sec at fixed p99 next to MTEPS.
 """
 
 from libgrape_lite_tpu.serve.batch import run_guarded_batch
+from libgrape_lite_tpu.serve.feeder import ArrivalFeeder
 from libgrape_lite_tpu.serve.pipeline import (
     PUMP_STATS,
     AsyncServePump,
@@ -39,6 +40,7 @@ from libgrape_lite_tpu.serve.session import ServeSession
 
 __all__ = [
     "AdmissionQueue",
+    "ArrivalFeeder",
     "AsyncServePump",
     "BatchPolicy",
     "PUMP_STATS",

@@ -1,0 +1,73 @@
+"""Threaded admission front: real wall-clock arrivals (ROADMAP 2a).
+
+The scripted serving drivers submit every query up front, so
+`BatchPolicy.max_wait_s` and the priority/deadline scheduling in
+`AdmissionQueue._pop_ready` are never exercised under load — the
+queue head has always "waited forever" by the time the pump runs.
+`ArrivalFeeder` fixes that with ONE feeder thread that submits the
+scripted stream at a fixed arrival rate (deterministic 1/rate
+spacing — reproducible arrival ORDER; the wall-clock timestamps are
+the point), while the caller's thread keeps pumping:
+
+    feeder = ArrivalFeeder(sess.submit, stream, rate_qps=200.0)
+    feeder.start()
+    while feeder.is_alive() or sess.queue.pending():
+        sess.pump(force=False)   # max_wait_s now genuinely gates
+    feeder.join(); sess.drain()
+
+`AdmissionQueue.submit` and `_pop_ready` share a lock, so the feeder
+thread and the pump thread never race on the pending list.  The
+deterministic scripted mode (no feeder) is byte-for-bit untouched —
+this module only ADDS a second producer.
+
+The CLI surface is `serve --arrival_rate QPS`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List
+
+
+class ArrivalFeeder(threading.Thread):
+    """Submit `stream` items through `submit_fn` at `rate_qps`
+    arrivals/second.  Items are (app_key, args) pairs or dicts in the
+    `ServeSession.serve` format (optionally carrying max_rounds /
+    guard / priority / deadline_s / tenant).  Submitted requests
+    accumulate in `self.requests` in arrival order."""
+
+    def __init__(self, submit_fn: Callable, stream, rate_qps: float,
+                 name: str = "grape-feeder"):
+        super().__init__(name=name, daemon=True)
+        if rate_qps <= 0:
+            raise ValueError(f"rate_qps must be > 0, got {rate_qps}")
+        self._submit = submit_fn
+        self._stream = list(stream)
+        self.rate_qps = float(rate_qps)
+        self.requests: List = []
+        self.submitted = 0
+
+    def run(self) -> None:
+        period = 1.0 / self.rate_qps
+        t0 = time.perf_counter()
+        for i, item in enumerate(self._stream):
+            # absolute schedule (t0 + i*period), not sleep(period):
+            # a slow submit must not stretch every later arrival
+            delay = t0 + i * period - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            if isinstance(item, dict):
+                req = self._submit(
+                    item["app"], item.get("args"),
+                    max_rounds=item.get("max_rounds"),
+                    guard=item.get("guard"),
+                    priority=item.get("priority", 0),
+                    deadline_s=item.get("deadline_s"),
+                    tenant=item.get("tenant"),
+                )
+            else:
+                app_key, args = item
+                req = self._submit(app_key, args)
+            self.requests.append(req)
+            self.submitted += 1

@@ -503,6 +503,9 @@ class AsyncServePump:
         call ([] = nothing was ready)."""
         out: List[ServeResult] = []
         self._fill(now, force=force, max_dispatch=max_dispatch)
+        # deadline-expired requests fail at pop time inside the queue;
+        # surface them with this step's results (never silently lost)
+        out.extend(self.session.queue.take_expired())
         while True:
             got = self._harvest_head(block=False)
             if not got:
@@ -524,7 +527,9 @@ class AsyncServePump:
         out: List[ServeResult] = []
         while self.session.queue.pending() or self._inflight:
             self._fill(force=True)
+            out.extend(self.session.queue.take_expired())
             out.extend(self._harvest_head(block=True))
+        out.extend(self.session.queue.take_expired())
         return out
 
     def quiesce(self, reason: str = "quiesce") -> List[ServeResult]:

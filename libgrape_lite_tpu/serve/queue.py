@@ -12,6 +12,15 @@ queued — admission never reorders within a class, and an incompatible
 head never blocks forever because `drain`/timeout forces partial
 batches).
 
+Scheduling (r13): requests carry an optional `priority` class — the
+queue always serves the highest class present, FIFO within a class,
+and classes never coalesce — and an optional `deadline_s`; a request
+whose deadline passes before it dispatches FAILS as a ServeResult
+with the recorded reason (`take_expired` returns them through every
+pump/drain surface), never a silent drop.  `submit` is thread-safe
+against `_pop_ready` (one lock) so the threaded admission front
+(serve/feeder.py) can produce while the pump consumes.
+
 The pop/dispatch/deliver split (`_pop_ready` / the dispatch callback /
 `deliver`) exists for the async pump (serve/pipeline.py): the pump
 pops ready batches with the SAME policy decision this module's own
@@ -24,6 +33,7 @@ one implementation regardless of how many batches are in flight.
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -35,15 +45,46 @@ from libgrape_lite_tpu.serve.policy import BatchPolicy
 _IDS = itertools.count()
 
 
+def latency_summary_ms(latencies) -> dict:
+    """{n, p50_ms, p99_ms} of a latency list (seconds in, ms out) —
+    THE one percentile convention (sorted ascending, index
+    `min(n-1, int(n*p))`) shared by the admission-wait record, the
+    CLI global and per-app summaries, and the fleet per-replica /
+    per-tenant summaries.  Five hand-rolled copies of this index
+    arithmetic would drift; one helper cannot."""
+    if not latencies:
+        return {"n": 0, "p50_ms": 0.0, "p99_ms": 0.0}
+    lat = sorted(latencies)
+    return {
+        "n": len(lat),
+        "p50_ms": round(1e3 * lat[len(lat) // 2], 3),
+        "p99_ms": round(
+            1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3
+        ),
+    }
+
+
 @dataclass
 class QueryRequest:
     """One admitted query (serve/): app + args + the limits that gate
-    coalescing (policy.compat_key)."""
+    coalescing (policy.compat_key).
+
+    `priority` picks the scheduling class: the queue always serves the
+    highest class present, FIFO within a class, and requests of
+    different classes never coalesce.  `deadline_s` (seconds from
+    submission) expires a request that has not DISPATCHED in time —
+    it fails as a ServeResult with the recorded reason, never a
+    silent drop.  `tenant` (fleet/) tags the owning tenant; requests
+    of different tenants never share a batched dispatch, so one
+    tenant's poisoned lane can never fail a batchmate tenant."""
 
     app_key: str
     args: dict
     max_rounds: Optional[int] = None
     guard: Optional[str] = None
+    priority: int = 0
+    deadline_s: Optional[float] = None
+    tenant: Optional[str] = None
     id: int = field(default_factory=lambda: next(_IDS))
     submitted_s: float = field(default_factory=time.perf_counter)
     result: Optional["ServeResult"] = None
@@ -127,11 +168,22 @@ class AdmissionQueue:
         self._dispatch = dispatch
         self.policy = policy or BatchPolicy()
         self._compat = compat_key or (
-            lambda r: (r.app_key, r.max_rounds, r.guard or "")
+            lambda r: (r.app_key, r.max_rounds, r.guard or "", r.tenant)
         )
         self._pending: List[QueryRequest] = []
+        # guards _pending (and the expired stash) against the threaded
+        # admission front (serve/feeder.py): submit may run on a feeder
+        # thread while the pump thread pops — everything else stays
+        # single-threaded and the scripted mode pays one uncontended
+        # acquire per call
+        self._lock = threading.Lock()
         self.batch_hist: Dict[int, int] = {}
         self.completed = 0
+        # deadline-expired requests failed (never silently dropped):
+        # count here, reason on each result, results returned by the
+        # next pump/drain via take_expired()
+        self.expired = 0
+        self._expired_out: List[ServeResult] = []
         # per-request submit->dispatch wait (seconds), recorded at pop
         # time next to the batch-size histogram: the admission-latency
         # half of the serving story (the histogram says how well the
@@ -141,27 +193,78 @@ class AdmissionQueue:
 
     def submit(self, app_key: str, args: dict | None = None, *,
                max_rounds: int | None = None,
-               guard: str | None = None) -> QueryRequest:
+               guard: str | None = None, priority: int = 0,
+               deadline_s: float | None = None,
+               tenant: str | None = None) -> QueryRequest:
         req = QueryRequest(
             app_key=app_key, args=dict(args or {}),
             max_rounds=max_rounds, guard=guard,
+            priority=int(priority), deadline_s=deadline_s,
+            tenant=tenant,
         )
-        self._pending.append(req)
+        with self._lock:
+            self._pending.append(req)
         return req
 
     def pending(self) -> int:
         return len(self._pending)
 
+    def _expire_overdue(self, now: float) -> None:
+        """Fail (not drop) every pending request whose deadline passed
+        before it dispatched: the request gets an error ServeResult
+        with the recorded reason and rides out through take_expired().
+        Caller holds the lock."""
+        live: List[QueryRequest] = []
+        for req in self._pending:
+            if (req.deadline_s is not None
+                    and now - req.submitted_s > req.deadline_s):
+                waited = now - req.submitted_s
+                res = ServeResult(
+                    request_id=req.id, app_key=req.app_key, ok=False,
+                    error={
+                        "error": "deadline expired before dispatch",
+                        "reason": "deadline_expired",
+                        "deadline_s": req.deadline_s,
+                        "waited_s": round(waited, 6),
+                    },
+                    latency_s=waited,
+                )
+                req.result = res
+                self._expired_out.append(res)
+                self.expired += 1
+                self.completed += 1
+            else:
+                live.append(req)
+        self._pending = live
+
+    def take_expired(self) -> List[ServeResult]:
+        """Drain the deadline-expired results (pump/drain and the
+        async pump call this so an expired request is always RETURNED
+        to the driver, never silently dropped)."""
+        with self._lock:
+            out, self._expired_out = self._expired_out, []
+        return out
+
     def _head_batch(self) -> List[QueryRequest]:
         """The head request plus the next compatible requests in FIFO
-        order, up to max_batch lanes."""
-        head = self._pending[0]
+        order, up to max_batch lanes.  The head is the FIRST request
+        of the HIGHEST priority class present (FIFO within a class);
+        only same-class requests may join its batch, so a low-priority
+        straggler never rides an urgent dispatch."""
+        top = max(r.priority for r in self._pending)
+        head = next(r for r in self._pending if r.priority == top)
         key = self._compat(head)
         batch = [head]
-        for req in self._pending[1:]:
+        seen_head = False
+        for req in self._pending:
+            if req is head:
+                seen_head = True
+                continue
+            if not seen_head:
+                continue
             if len(batch) >= self.policy.max_batch:
                 break
-            if self._compat(req) == key:
+            if req.priority == top and self._compat(req) == key:
                 batch.append(req)
         return batch
 
@@ -170,18 +273,23 @@ class AdmissionQueue:
         """Pop at most ONE ready batch off the queue — the policy
         decision shared by the synchronous `pump` and the async pump's
         dispatch stage (serve/pipeline.py).  Ready = full, head waited
-        `max_wait_s`, or `force`d.  Records each popped request's
-        submit->dispatch wait.  [] = nothing ready."""
-        if not self._pending:
-            return []
-        batch = self._head_batch()
-        if not force and len(batch) < self.policy.max_batch:
-            now = time.perf_counter() if now is None else now
-            head_wait = now - self._pending[0].submitted_s
-            if head_wait < self.policy.max_wait_s:
+        `max_wait_s`, or `force`d.  Expires overdue deadlines first
+        (failed results, via take_expired).  Records each popped
+        request's submit->dispatch wait.  [] = nothing ready."""
+        now = time.perf_counter() if now is None else now
+        with self._lock:
+            self._expire_overdue(now)
+            if not self._pending:
                 return []
-        ids = {r.id for r in batch}
-        self._pending = [r for r in self._pending if r.id not in ids]
+            batch = self._head_batch()
+            if not force and len(batch) < self.policy.max_batch:
+                head_wait = now - batch[0].submitted_s
+                if head_wait < self.policy.max_wait_s:
+                    return []
+            ids = {r.id for r in batch}
+            self._pending = [
+                r for r in self._pending if r.id not in ids
+            ]
         t_pop = time.perf_counter()
         from libgrape_lite_tpu import obs
 
@@ -221,31 +329,25 @@ class AdmissionQueue:
         """p50/p99 of the recorded submit->dispatch waits, in ms (the
         CLI `serve` summary and the bench serve_async block surface
         this next to qps)."""
-        if not self.admission_waits:
-            return {"n": 0, "p50_ms": 0.0, "p99_ms": 0.0}
-        lat = sorted(self.admission_waits)
-        return {
-            "n": len(lat),
-            "p50_ms": round(1e3 * lat[len(lat) // 2], 3),
-            "p99_ms": round(
-                1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3
-            ),
-        }
+        return latency_summary_ms(self.admission_waits)
 
     def pump(self, now: float | None = None, *,
              force: bool = False) -> List[ServeResult]:
         """Dispatch at most ONE batch: when it is full, when the head
         request has waited `max_wait_s`, or when `force`d (drain).
-        Returns the delivered results ([] = nothing was ready)."""
+        Returns the delivered results, including any deadline-expired
+        failures ([] = nothing was ready)."""
         batch = self._pop_ready(now, force=force)
+        out = self.take_expired()
         if not batch:
-            return []
-        return self.deliver(batch, self._dispatch(batch))
+            return out
+        out.extend(self.deliver(batch, self._dispatch(batch)))
+        return out
 
     def drain(self) -> List[ServeResult]:
         """Pump until the queue is empty (partial batches forced) —
         the scripted-stream mode of the CLI `serve` subcommand."""
-        out: List[ServeResult] = []
+        out: List[ServeResult] = self.take_expired()
         while self._pending:
             out.extend(self.pump(force=True))
         return out

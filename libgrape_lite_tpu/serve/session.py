@@ -85,6 +85,7 @@ class ServeSession:
         )
         self._workers: Dict[str, Worker] = {}
         self._pump = None  # the attached AsyncServePump, if any
+        self._closed = False
         self.stats = {
             "queries": 0, "batches": 0, "failed": 0,
             "sequential_fallbacks": 0,
@@ -121,6 +122,62 @@ class ServeSession:
             runner["hits"] += w.runner_cache_stats["hits"]
             runner["misses"] += w.runner_cache_stats["misses"]
         return {"runner": runner, "pack": plan_stats()}
+
+    # ---- lifecycle: eviction / re-admission / close (fleet/) --------------
+
+    @property
+    def resident(self) -> bool:
+        """True while the fragment's device arrays are placed (a
+        released/evicted session keeps every host artifact but holds
+        no HBM)."""
+        return self.fragment.dev is not None
+
+    def release_device(self, *,
+                       release_fragment: bool = True) -> dict:
+        """Evict this session's device footprint: quiesce any attached
+        pump, drop each resident worker's retained result buffers
+        (`Worker.release_buffers`), and — unless the fragment is
+        shared with a sibling session (`release_fragment=False`, the
+        FleetManager's call) — delete the fragment's device arrays.
+
+        Everything HOST-side stays warm: the per-fragment pack-plan
+        cache (weak-keyed on this very fragment object), the v3 disk
+        plan cache, the compiled-runner caches, the mirror plans.
+        `restore_device` therefore re-admits with ZERO pack
+        re-planning and ZERO XLA recompiles — counter- and
+        compile_events-pinned by tests/test_fleet.py."""
+        if self._pump is not None and self._pump.inflight():
+            self._pump.quiesce(reason="release_device")
+        for w in self._workers.values():
+            w.release_buffers()
+        released = False
+        if release_fragment:
+            released = self.fragment.release_device()
+        return {"fragment_released": released,
+                "workers": len(self._workers)}
+
+    def restore_device(self) -> bool:
+        """Re-admit an evicted session: re-place the device arrays
+        from the retained host CSRs (byte-identical content — the
+        build is deterministic).  Returns True when a placement
+        actually happened (False: already resident, e.g. a shared
+        fragment restored by a sibling)."""
+        if self._closed:
+            raise RuntimeError("session is closed")
+        return self.fragment.restore_device()
+
+    def close(self) -> None:
+        """Terminal release: drain + detach the pump, release the
+        device footprint, and drop the resident workers (their
+        compiled-runner caches go with them).  Further submits raise;
+        close is idempotent."""
+        if self._closed:
+            return
+        if self._pump is not None:
+            self._pump.close()
+        self.release_device()
+        self._workers.clear()
+        self._closed = True
 
     # ---- live ingest (dyn/) ----------------------------------------------
 
@@ -195,23 +252,31 @@ class ServeSession:
         # head of the queue forever — the dispatch path turns the
         # lookup failure into per-request error results instead
         if req.app_key not in self.apps:
-            return (req.app_key, "?unknown")
+            return (req.app_key, "?unknown", req.tenant)
         # batch_query_key is a CLASS attribute: read it off the
         # registered app class directly — instantiating the resident
         # Worker here (as this method once did) built state and pack
         # plans while the queue was merely PICKING a batch, so a bare
-        # submit of a never-dispatched app paid a full worker warmup
+        # submit of a never-dispatched app paid a full worker warmup.
+        # The tenant tag joins the key so requests of DIFFERENT
+        # tenants never share a batched dispatch — one tenant's
+        # poisoned lane can never fail a batchmate tenant (fleet/).
         return compat_key(
             req.app_key, req.args, req.max_rounds,
             req.guard or self.guard,
             getattr(self.apps[req.app_key], "batch_query_key", None),
-        )
+        ) + (req.tenant,)
 
     def submit(self, app_key: str, args: dict | None = None, *,
                max_rounds: int | None = None,
-               guard: str | None = None) -> QueryRequest:
+               guard: str | None = None, priority: int = 0,
+               deadline_s: float | None = None,
+               tenant: str | None = None) -> QueryRequest:
+        if self._closed:
+            raise RuntimeError("session is closed")
         return self.queue.submit(
-            app_key, args, max_rounds=max_rounds, guard=guard
+            app_key, args, max_rounds=max_rounds, guard=guard,
+            priority=priority, deadline_s=deadline_s, tenant=tenant,
         )
 
     def pump(self, **kw) -> List[ServeResult]:
@@ -241,6 +306,9 @@ class ServeSession:
                     item["app"], item.get("args"),
                     max_rounds=item.get("max_rounds"),
                     guard=item.get("guard"),
+                    priority=item.get("priority", 0),
+                    deadline_s=item.get("deadline_s"),
+                    tenant=item.get("tenant"),
                 )
             else:
                 app_key, args = item

@@ -326,6 +326,24 @@ class Worker:
                 "ServeSession.ingest handles this automatically)"
             )
 
+    def release_buffers(self) -> None:
+        """Drop this worker's device-resident references — the last
+        query's result carry, its fragment provenance, the guard
+        monitor, and any device copies of const-mode pack streams
+        (they lazily rebuild from the cached host plan) — so a fleet
+        eviction (ServeSession.release_device) actually frees the
+        HBM.  The compiled-runner cache is KEPT: re-admission must
+        compile nothing (tests/test_fleet.py pins it)."""
+        self._result_state = None
+        self._result_fragment = None
+        self._guard_monitor = None
+        self.batch_rounds = None
+        self.batch_terminate = None
+        self.batch_breaches = None
+        pack = getattr(self.app, "_pack", None)
+        if pack is not None and hasattr(pack, "_const"):
+            pack._const = None
+
     def get_terminate_info(self):
         """(success, info) — reference `Worker::GetTerminateInfo`
         (worker.h:150-152)."""

@@ -236,6 +236,44 @@ print(f"  OK (cmp-identical across windows; engaged={p['engaged']}, "
       f"overlapped={p['overlapped_harvests']})")
 EOF
 
+echo "== fleet: 2 tenants x 2 replicas + drain, cmp-identical to single-replica (fnum=2) =="
+# the serving-fleet smoke (fleet/, docs/FLEET.md): the SAME mixed
+# stream + 10-op delta stream through the CLI, once plain and once as
+# a 2-replica router with a by_app tenant split and replica 0 drained
+# mid-stream (it rejoins through its catch-up log after the next
+# ingest barrier) — per-query value digests must be byte-identical
+# (zero-downtime drain, version-fenced ingest), zero queries dropped,
+# and both replicas must have genuinely served traffic
+python -m libgrape_lite_tpu.cli serve \
+  --efile "$DS/p2p-31.e" --vfile "$DS/p2p-31.v" $PLATFORM_ARGS --fnum 2 \
+  --stream "$OUT/dyn_stream.txt" --max_batch 4 \
+  --delta_stream "$OUT/dyn_delta.txt" --ingest_every 8 \
+  --dyn_repack_ratio 0.5 \
+  --dump_results "$OUT/fleet_r1.res" > "$OUT/fleet_r1.json"
+python -m libgrape_lite_tpu.cli serve \
+  --efile "$DS/p2p-31.e" --vfile "$DS/p2p-31.v" $PLATFORM_ARGS --fnum 2 \
+  --stream "$OUT/dyn_stream.txt" --max_batch 4 \
+  --delta_stream "$OUT/dyn_delta.txt" --ingest_every 8 \
+  --dyn_repack_ratio 0.5 --replicas 2 --tenants by_app --drain_at 12 \
+  --dump_results "$OUT/fleet_r2.res" > "$OUT/fleet_r2.json"
+cmp "$OUT/fleet_r1.res" "$OUT/fleet_r2.res" \
+  || { echo "FLEET (R=2, drained) DIVERGED FROM THE SINGLE-REPLICA RUN" >&2; exit 1; }
+python - "$OUT/fleet_r2.json" <<'EOF'
+import json, sys
+rec = json.loads(
+    [l for l in open(sys.argv[1]) if l.startswith("{")][-1])
+assert rec["queries"] == 24 and rec["failed"] == 0, rec
+fl = rec["fleet"]
+assert fl["replicas"] == 2 and fl["tenants"] == 2, fl
+assert fl["dropped"] == 0 and fl["drains"] == 1, fl
+reps = fl["router"]["replicas"]
+assert all(r["served"] > 0 for r in reps.values()), reps
+assert len({r["version"] for r in reps.values()}) == 1, reps
+print(f"  OK (cmp-identical; fence={fl['router']['fence']}, "
+      + ", ".join(f"{k} served {v['served']}" for k, v in reps.items())
+      + ")")
+EOF
+
 echo "== grape-lint: static contract rules, zero unsuppressed findings =="
 # the AST gate (R1-R7, analysis/): exits 1 on any finding the
 # baseline does not name, 3 if the --json record drifts from its own

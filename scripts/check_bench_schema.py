@@ -29,23 +29,17 @@ import sys
 
 _NUM = (int, float)
 
-# field -> (type tuple, required)
-_TOP = {
+# field -> (type tuple, required).  Top-level SCALAR fields only —
+# every nested block is declared once in _BLOCKS below and wired into
+# _TOP / SCHEMA / validate_record / the CLI listing BY CONSTRUCTION
+# (the PR 9/11/12 wiring-gap class: a block declared here but
+# forgotten in one of the four consumers silently validated nothing).
+_TOP_SCALARS = {
     "metric": (str, True),
     "value": (_NUM, True),
     "unit": (str, True),
     "vs_baseline": (_NUM, True),
     "load_avg_1m": (_NUM, False),
-    "sssp": (dict, False),
-    "guard": (dict, False),
-    "pack_ledger": (dict, False),
-    "obs": (dict, False),
-    "serve": (dict, False),
-    "serve_async": (dict, False),
-    "dyn": (dict, False),
-    "pipeline": (dict, False),
-    "partition2d": (dict, False),
-    "spgemm": (dict, False),
 }
 
 _SSSP = {
@@ -242,8 +236,45 @@ _SPAN_ROLLUP = {
     "max_s": (_NUM, True),
 }
 
-SCHEMA = {
-    "": _TOP,
+# the r13 serving-fleet lane (fleet/, docs/FLEET.md): the drain drill
+# — R=2 replicas serving the query stream with concurrent barrier
+# ingest, one replica drained mid-run — with per-replica qps@p99 (the
+# ROADMAP's stated target bench), the byte-identity verdict vs the
+# undrained R=1 run (bench exits 2 when it breaks), the
+# dropped-query count (must be 0), and the budget/eviction counters.
+# Verdict fields are DECLARED bool, like the pipeline lane's.
+_FLEET = {
+    "scale": (int, True),
+    "replicas": (int, True),
+    "tenants": (int, True),
+    "queries": (int, True),
+    "ok": (int, True),
+    "dropped": (int, True),
+    "drain_at": (int, True),
+    "drained_replica": (int, True),
+    "drain_wall_s": (_NUM, True),
+    "catchup_ops": (int, True),
+    "updates": (int, True),
+    "updates_per_s": (_NUM, True),
+    "fence": (int, True),
+    "byte_identical": (bool, True),
+    "per_replica": (dict, True),
+    "evictions": (int, True),
+    "readmit_compiles": (int, False),
+}
+
+_FLEET_REPLICA = {
+    "qps": (_NUM, True),
+    "p50_ms": (_NUM, True),
+    "p99_ms": (_NUM, True),
+    "served": (int, True),
+    "ok": (int, True),
+}
+
+#: every nested block bench.py may emit — THE single declaration
+#: point; _TOP, SCHEMA, validate_record and the CLI listing all
+#: derive from it (self_check() pins the derivation)
+_BLOCKS = {
     "sssp": _SSSP,
     "guard": _GUARD,
     "pack_ledger": _PACK_LEDGER,
@@ -254,7 +285,51 @@ SCHEMA = {
     "pipeline": _PIPELINE,
     "partition2d": _PARTITION2D,
     "spgemm": _SPGEMM,
+    "fleet": _FLEET,
 }
+
+_TOP = {**_TOP_SCALARS, **{k: (dict, False) for k in _BLOCKS}}
+
+SCHEMA = {"": _TOP, **_BLOCKS}
+
+
+def self_check() -> list:
+    """The wiring-gap gate: every DECLARED block must be wired into
+    _TOP, SCHEMA and validate_record — which all derive from _BLOCKS,
+    so the only way to regress is to bypass the derivation; this
+    check fails the CLI (exit 2) and tests/test_fleet.py if anyone
+    does.  Returns a list of inconsistencies (empty = wired)."""
+    errors = []
+    top_blocks = {
+        k for k, (types, _) in _TOP.items()
+        if (types if isinstance(types, tuple) else (types,)) == (dict,)
+    }
+    if top_blocks != set(_BLOCKS):
+        errors.append(
+            f"_TOP dict-typed fields {sorted(top_blocks)} != declared "
+            f"blocks {sorted(_BLOCKS)}"
+        )
+    if set(SCHEMA) != {""} | set(_BLOCKS):
+        errors.append(
+            f"SCHEMA keys {sorted(SCHEMA)} != '' + declared blocks"
+        )
+    for name, spec in _BLOCKS.items():
+        if SCHEMA.get(name) is not spec:
+            errors.append(f"SCHEMA[{name!r}] is not the declared spec")
+    # validate_record must actually CHECK every declared block: feed
+    # it a record where every block violates its spec and demand one
+    # error per block
+    probe = {k: {"__not_a_field__": 1} for k in _BLOCKS}
+    probe.update({"metric": "x", "value": 1, "unit": "u",
+                  "vs_baseline": 1.0})
+    found = validate_record(probe)
+    for name in _BLOCKS:
+        if not any(e.startswith(f"{name}.") or e.startswith(f"{name}:")
+                   for e in found):
+            errors.append(
+                f"validate_record never checked block {name!r}"
+            )
+    return errors
 
 
 def _check_block(block: dict, spec: dict, where: str, errors: list,
@@ -294,13 +369,7 @@ def validate_record(record) -> list:
     if not isinstance(record, dict):
         return [f"record is {type(record).__name__}, expected object"]
     _check_block(record, _TOP, "record", errors)
-    for key, spec in (("sssp", _SSSP), ("guard", _GUARD),
-                      ("pack_ledger", _PACK_LEDGER), ("obs", _OBS),
-                      ("serve", _SERVE),
-                      ("serve_async", _SERVE_ASYNC), ("dyn", _DYN),
-                      ("pipeline", _PIPELINE),
-                      ("partition2d", _PARTITION2D),
-                      ("spgemm", _SPGEMM)):
+    for key, spec in _BLOCKS.items():
         block = record.get(key)
         if isinstance(block, dict):
             _check_block(block, spec, key, errors)
@@ -404,6 +473,21 @@ def validate_record(record) -> list:
                         f"serve_async.admission_wait_ms.{q}: expected "
                         f"number, got {type(v).__name__}"
                     )
+    fl = record.get("fleet")
+    if isinstance(fl, dict):
+        pr = fl.get("per_replica")
+        if isinstance(pr, dict):
+            for rkey, point in pr.items():
+                where = f"fleet.per_replica[{rkey!r}]"
+                if not (rkey.startswith("r") and rkey[1:].isdigit()):
+                    errors.append(
+                        f"{where}: replica keys look like r<k>"
+                    )
+                    continue
+                if not isinstance(point, dict):
+                    errors.append(f"{where}: expected object")
+                    continue
+                _check_block(point, _FLEET_REPLICA, where, errors)
     return errors
 
 
@@ -436,6 +520,14 @@ def _records_from_text(text: str, where: str):
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    # the self-consistency gate runs FIRST: a declared-but-unwired
+    # block must fail the tool itself, not quietly validate nothing
+    wiring = self_check()
+    if wiring:
+        print("FAIL schema self-check:", file=sys.stderr)
+        for e in wiring:
+            print(f"  - {e}", file=sys.stderr)
+        return 2
     if not argv:
         print(__doc__.strip().splitlines()[0], file=sys.stderr)
         print("usage: check_bench_schema.py FILE... (or - for stdin)",
@@ -458,11 +550,7 @@ def main(argv=None) -> int:
                 for e in errors:
                     print(f"  - {e}")
             else:
-                blocks = [k for k in ("sssp", "guard", "pack_ledger",
-                                      "obs", "serve", "serve_async",
-                                      "dyn", "pipeline",
-                                      "partition2d", "spgemm")
-                          if k in record]
+                blocks = [k for k in _BLOCKS if k in record]
                 print(f"OK {label} ({record.get('metric')}"
                       + (f"; blocks: {', '.join(blocks)}" if blocks
                          else "") + ")")
