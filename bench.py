@@ -81,6 +81,18 @@ artifacts); any verdict failure exits 2.  Env knobs:
 GRAPE_BENCH_NO_FLEET=1 skips, GRAPE_BENCH_FLEET_QUERIES / _UPDATES
 size the lane (scale follows GRAPE_BENCH_SERVE_SCALE).
 
+BENCH-json telemetry fields (r15): `telemetry` carries the
+observability plane's own lane (obs/, docs/OBSERVABILITY.md) — the
+stats-federation census (`namespaces` registered + the
+`federation_ok` self_check verdict), `scrape_ok` from a LIVE
+mid-process scrape of the OpenMetrics exporter (the text must name
+every federated namespace and end with `# EOF`), `stages` with the
+per-stage p50/p99 latency decomposition from ServeResult.stages
+(queue_wait/window_wait/dispatch/device/harvest), the SLO burn under
+a generous objective, and the flight-recorder counters.  Env knobs:
+GRAPE_BENCH_NO_TELEMETRY=1 skips, GRAPE_BENCH_TELEMETRY_SCALE /
+_QUERIES size the lane.
+
 BENCH-json dyn fields (r10): `dyn` carries the dynamic-graph lane
 (dyn/, docs/DYNAMIC_GRAPHS.md) — `updates_per_s` ingested through
 ServeSession.ingest while an SSSP query stream stays live (overlay
@@ -1034,6 +1046,94 @@ def main():
         except Exception as e:  # the serve lane must not cost the bench
             print(f"[bench] serve lane failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+
+    # telemetry lane (r15, obs/, docs/OBSERVABILITY.md): the stats-
+    # federation census (registered namespaces + self_check verdict),
+    # a LIVE scrape of the OpenMetrics exporter taken mid-serve (the
+    # text must name every federated namespace), the per-stage
+    # latency decomposition from ServeResult.stages, the SLO burn
+    # under a generous objective, and the flight-recorder counters.
+    # GRAPE_BENCH_NO_TELEMETRY=1 skips.
+    if not os.environ.get("GRAPE_BENCH_NO_TELEMETRY"):
+        try:
+            import urllib.request
+
+            from libgrape_lite_tpu.obs import exporter, federation, slo
+            from libgrape_lite_tpu.obs.recorder import REC_STATS
+            from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+            from libgrape_lite_tpu.serve.queue import latency_summary_ms
+
+            tel_scale = int(os.environ.get(
+                "GRAPE_BENCH_TELEMETRY_SCALE", min(SCALE, 10)))
+            n_q = int(os.environ.get(
+                "GRAPE_BENCH_TELEMETRY_QUERIES", 16))
+            tn, tsrc, tdst, tcomm, tvm, tfrag = build_bench_fragment(
+                tel_scale
+            )
+            # a generous objective: observed counters move per query,
+            # burn stays 0 unless something is genuinely pathological
+            slo.configure("*=60000")
+            exp = exporter.start_exporter(0)
+            sess = ServeSession(tfrag, policy=BatchPolicy(max_batch=8))
+            pump = sess.async_pump(window=2)
+            rng_t = np.random.default_rng(6)
+            for s in (int(x) for x in rng_t.integers(0, tn, size=n_q)):
+                sess.submit("bfs", {"source": s})
+            results = []
+            while sess.queue.pending() or pump.inflight():
+                results.extend(pump.pump(force=True, block=True))
+            results.extend(pump.drain())
+            # the live mid-process scrape: every federated namespace
+            # must be named in the OpenMetrics text
+            scrape_ok = False
+            try:
+                with urllib.request.urlopen(
+                    exp.url + "/metrics", timeout=5
+                ) as resp:
+                    text = resp.read().decode("utf-8")
+                scrape_ok = all(
+                    f'grape_stats_registry{{namespace="{ns}"}}' in text
+                    for ns in federation.registered()
+                ) and text.endswith("# EOF\n")
+            finally:
+                exporter.stop_exporter()
+            stage_lists: dict = {}
+            for r in results:
+                for k, v in (r.stages or {}).items():
+                    stage_lists.setdefault(k, []).append(v / 1e6)
+            stages_block = {}
+            for k, v in sorted(stage_lists.items()):
+                s = latency_summary_ms(v)
+                stages_block[k] = {"p50": s["p50_ms"],
+                                   "p99": s["p99_ms"]}
+            fed_errors = federation.self_check()
+            slo_snap = slo.SLO_STATS.snapshot()
+            telemetry_block = {
+                "namespaces": len(federation.registered()),
+                "federation_ok": not fed_errors,
+                "scrape_ok": scrape_ok,
+                "stages": stages_block,
+                "slo_observed": int(slo_snap["observed"]),
+                "slo_breaches": int(slo_snap["breaches"]),
+                "slo_max_burn": float(slo_snap["max_burn"]),
+                "recorder_recorded": int(REC_STATS["recorded"]),
+                "recorder_dropped": int(REC_STATS["dropped"]),
+                "recorder_triggers": int(REC_STATS["triggers"]),
+            }
+            print(
+                f"[bench] telemetry: {telemetry_block['namespaces']} "
+                f"namespace(s), scrape_ok={scrape_ok}, "
+                f"federation_ok={telemetry_block['federation_ok']}, "
+                f"stages={sorted(stages_block)}",
+                file=sys.stderr,
+            )
+            record["telemetry"] = telemetry_block
+            _emit_record(record)
+        except Exception as e:  # the telemetry lane must not cost the bench
+            print(
+                f"[bench] telemetry lane failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
 
     # async-pump serving lane (r12, ROADMAP item 2a): the dispatch-
     # window A/B — W in {1, 4} at batch sizes {1, 8, 32} over the

@@ -1,4 +1,4 @@
-"""Layer 1 of grape-lint: AST checks R1-R7 over the library source.
+"""Layer 1 of grape-lint: AST checks R1-R8 over the library source.
 
 Each checker's docstring names the historical, actually-shipped bug it
 fossilizes (see analysis/rules.py for the catalogue and CHANGES.md for
@@ -956,12 +956,119 @@ def _check_r7(module: _Scope, path: str, findings: List[Finding]) -> None:
 
 
 # ---------------------------------------------------------------------------
+# R8 — module-level *_STATS surfaces outside the stats federation
+# ---------------------------------------------------------------------------
+
+_R8_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*_STATS$")
+_R8_FED_MODULE = "libgrape_lite_tpu.obs.federation"
+_R8_OBS_MODULE = "libgrape_lite_tpu.obs"
+
+
+def _r8_federation_names(tree: ast.Module):
+    """Names under which this module can reach the federation:
+    (module aliases of obs.federation / obs, direct `register` names,
+    direct `FederatedStats` constructor names).  Function-level lazy
+    imports count — registering inside an init helper is still
+    registering."""
+    mod_aliases: Set[str] = set()
+    reg_names: Set[str] = set()
+    ctor_names: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ImportFrom):
+            if n.module == _R8_FED_MODULE:
+                for a in n.names:
+                    bound = a.asname or a.name
+                    if a.name == "register":
+                        reg_names.add(bound)
+                    elif a.name == "FederatedStats":
+                        ctor_names.add(bound)
+            elif n.module == _R8_OBS_MODULE:
+                for a in n.names:
+                    bound = a.asname or a.name
+                    if a.name == "federation":
+                        mod_aliases.add(bound)
+                    elif a.name == "FederatedStats":
+                        ctor_names.add(bound)
+        elif isinstance(n, ast.Import):
+            for a in n.names:
+                if a.name == _R8_FED_MODULE:
+                    mod_aliases.add(
+                        a.asname or _R8_FED_MODULE.split(".")[0]
+                    )
+    return mod_aliases, reg_names, ctor_names
+
+
+def _check_r8(module: _Scope, path: str,
+              findings: List[Finding]) -> None:
+    """R8 unfederated-stats.  A module-level ``*_STATS`` assignment
+    declares an operational ledger; the stats federation
+    (obs/federation.py) is THE registry every such surface must join
+    so one ``snapshot()`` — and therefore the live exporter and every
+    postmortem bundle — sees all of them.  A surface passes when its
+    value is constructed as ``FederatedStats(...)`` (self-registering)
+    or when the module calls ``federation.register(...)`` anywhere
+    (lazy/function-level registration counts).  obs/federation.py
+    itself is exempt: the registry cannot register into itself."""
+    if path.endswith("obs/federation.py"):
+        return
+    tree = module.node
+    mod_aliases, reg_names, ctor_names = _r8_federation_names(tree)
+
+    def registers(call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in reg_names:
+            return True
+        return (
+            isinstance(f, ast.Attribute)
+            and f.attr == "register"
+            and _root_name(f) in mod_aliases
+        )
+
+    if any(
+        isinstance(n, ast.Call) and registers(n)
+        for n in ast.walk(tree)
+    ):
+        return
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]
+            value = stmt.value
+        else:
+            continue
+        names = [
+            t.id for t in targets
+            if isinstance(t, ast.Name) and _R8_NAME_RE.match(t.id)
+        ]
+        if not names:
+            continue
+        if (
+            isinstance(value, ast.Call)
+            and _callee_base(value.func) in ctor_names
+        ):
+            continue
+        for name in names:
+            findings.append(Finding(
+                "R8", path, stmt.lineno, name,
+                f"module-level stats surface {name} is not in the "
+                "stats federation — construct it as "
+                "obs.federation.FederatedStats or call "
+                "federation.register(namespace, snapshot, reset) in "
+                "this module, so federation.snapshot(), the live "
+                "/metrics exporter, and postmortem bundles can see it",
+            ))
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
 
 def lint_source(src: str, relpath: str) -> List[Finding]:
-    """All R1-R7 findings for one module's source text."""
+    """All R1-R8 findings for one module's source text."""
     relpath = relpath.replace(os.sep, "/")
     try:
         tree = ast.parse(src)
@@ -985,6 +1092,7 @@ def lint_source(src: str, relpath: str) -> List[Finding]:
     _check_r5(module, relpath, findings)
     _check_r6(module, relpath, findings)
     _check_r7(module, relpath, findings)
+    _check_r8(module, relpath, findings)
     return findings
 
 

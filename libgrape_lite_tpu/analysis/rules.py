@@ -6,7 +6,7 @@ each class un-shippable instead of re-findable.  The rule ids are
 stable contract: findings, baselines, and commit messages cite them.
 
 The catalogue is data (id -> Rule); the checkers live in
-analysis/astlint.py (R1-R5, pure AST) and analysis/artifact.py
+analysis/astlint.py (R1-R8, pure AST) and analysis/artifact.py
 (A1-A3, audits on actually-lowered/compiled runners).  Layer 1 proves
 the source can't express the defect; Layer 2 recounts from the
 shipped artifact — the same two-sided discipline the pack op ledger
@@ -112,6 +112,19 @@ RULES: Dict[str, Rule] = {
             "batch could dispatch — the exact defect class the async "
             "pump removes; fossilized so it cannot creep back into "
             "the dispatch stage (zero-entry baseline)",
+        ),
+        Rule(
+            "R8", "unfederated-stats",
+            "a module-level *_STATS surface is neither constructed as "
+            "obs.federation.FederatedStats nor registered with "
+            "obs.federation.register in its defining module — the "
+            "ledger is invisible to federation.snapshot(), the live "
+            "/metrics exporter, and every postmortem bundle",
+            "PR 15: PLAN/SPGEMM/PARTITION/PIPELINE_STATS were four "
+            "hand-rolled module dicts and PUMP/FLEET_STATS two ad-hoc "
+            "classes, each with its own snapshot/reset idiom; a "
+            "scrape could not see them and a new one would have "
+            "drifted the same way (zero-entry baseline)",
         ),
         Rule(
             "A1", "constant-bloat",

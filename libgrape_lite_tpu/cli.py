@@ -15,10 +15,16 @@ zero-downtime mid-stream drain; `--arrival_rate` feeds the stream
 from a wall-clock feeder thread (serve/feeder.py).
 
 `python -m libgrape_lite_tpu.cli lint ...` runs grape-lint
-(analysis/, docs/STATIC_ANALYSIS.md): the AST contract rules R1-R7
+(analysis/, docs/STATIC_ANALYSIS.md): the AST contract rules R1-R8
 over the library tree (or explicit paths), optionally the
 compiled-artifact audits (--artifact), against the suppression
 baseline — exits nonzero on any unsuppressed finding.
+
+`python -m libgrape_lite_tpu.cli postmortem <bundle.json>` renders a
+flight-recorder bundle (obs/recorder.py; dumped into the
+GRAPE_POSTMORTEM sink on a guard breach, fence violation or deadline
+storm) and, with --trace, proves the bundle's serve_query span rows
+byte-match the Chrome trace's rows for the same query ids.
 """
 
 from __future__ import annotations
@@ -189,6 +195,20 @@ def make_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace", default="",
                    help="obs/ Chrome-trace path (per-query lane rows)")
     p.add_argument("--metrics", default="")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="obs/exporter.py: serve a live OpenMetrics "
+                        "endpoint from a background thread for the "
+                        "run's duration (/metrics, /federation, "
+                        "/healthz); 0 binds an ephemeral port (the "
+                        "URL prints to stderr); equivalent to "
+                        "GRAPE_METRICS_PORT")
+    p.add_argument("--slo", default="",
+                   help="obs/slo.py latency objectives, e.g. "
+                        "'sssp=5,tenant:t0=50,*=100' (ms per "
+                        "app/tenant); a breach is a trace instant + "
+                        "a federated error-budget burn counter, "
+                        "never an exception; equivalent to GRAPE_SLO "
+                        "(budget fraction: GRAPE_SLO_BUDGET)")
     p.add_argument("--platform", default="")
     p.add_argument("--cpu_devices", type=int, default=0)
     return p
@@ -318,6 +338,22 @@ def serve_main(argv=None):
 
         obs.configure(trace_path=ns.trace or None,
                       metrics_path=ns.metrics or None)
+    if ns.slo:
+        from libgrape_lite_tpu.obs import slo
+
+        slo.configure(ns.slo)
+    if ns.metrics_port is not None:
+        from libgrape_lite_tpu.obs import exporter
+
+        exp = exporter.start_exporter(ns.metrics_port)
+        print(f"[serve] metrics exporter: {exp.url}", file=sys.stderr)
+    else:
+        from libgrape_lite_tpu.obs import exporter
+
+        exp = exporter.maybe_start_from_env()
+        if exp is not None:
+            print(f"[serve] metrics exporter: {exp.url}",
+                  file=sys.stderr)
 
     from libgrape_lite_tpu.fragment.loader import LoadGraph, LoadGraphSpec
     from libgrape_lite_tpu.models import APP_REGISTRY
@@ -678,6 +714,22 @@ def _serve_summary(ns, sess, pump, reqs, results, wall, delta_ops,
         "per_app_ms": _per_app_latency_ms(results),
         "cache": cache,
     }
+    # per-stage p50/p99 decomposition (queue_wait/window_wait/
+    # dispatch/device/harvest µs, from ServeResult.stages): where the
+    # global p99 actually went — shared by plain, pump and fleet paths
+    stage_lists: dict = {}
+    for r in results:
+        for k, v in (r.stages or {}).items():
+            stage_lists.setdefault(k, []).append(v / 1e6)
+    if stage_lists:
+        record["stages"] = {}
+        for k, v in sorted(stage_lists.items()):
+            s = latency_summary_ms(v)
+            record["stages"][k] = {"p50": s["p50_ms"], "p99": s["p99_ms"]}
+    from libgrape_lite_tpu.obs import slo as _slo
+
+    if _slo.configured():
+        record["slo"] = _slo.SLO_STATS.snapshot()
     if pump is not None:
         from libgrape_lite_tpu.serve import PUMP_STATS
 
@@ -735,12 +787,123 @@ def _serve_summary(ns, sess, pump, reqs, results, wall, delta_ops,
         obs.flush()
 
 
+def make_postmortem_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="libgrape_lite_tpu postmortem")
+    p.add_argument("bundle",
+                   help="flight-recorder bundle json (obs/recorder.py "
+                        "writes one per trigger into the "
+                        "GRAPE_POSTMORTEM sink directory)")
+    p.add_argument("--trace", default="",
+                   help="Chrome trace file from the same run: verify "
+                        "every serve_query span row in the bundle "
+                        "byte-matches the trace's row for the same "
+                        "query id (exit 1 on any mismatch — the "
+                        "postmortem and the timeline must join "
+                        "row-for-row)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw bundle instead of the report")
+    return p
+
+
+def postmortem_main(argv=None) -> int:
+    """The `postmortem` subcommand: render a flight-recorder bundle,
+    and with --trace prove its span rows are the SAME rows as the
+    Chrome trace's (byte-equality of the sort_keys serialization per
+    query id — bundles copy tracer history verbatim, so any drift is
+    a recorder bug, not formatting noise)."""
+    import json
+    import sys
+    from collections import Counter
+
+    from libgrape_lite_tpu.obs.recorder import BUNDLE_SCHEMA
+
+    ns = make_postmortem_parser().parse_args(argv)
+    try:
+        with open(ns.bundle) as fh:
+            bundle = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"postmortem: {ns.bundle}: {e}", file=sys.stderr)
+        return 2
+    if bundle.get("schema") != BUNDLE_SCHEMA:
+        print(f"postmortem: {ns.bundle}: schema "
+              f"{bundle.get('schema')!r} != {BUNDLE_SCHEMA!r}",
+              file=sys.stderr)
+        return 2
+    if ns.json:
+        print(json.dumps(bundle, indent=1))
+        return 0
+
+    events = bundle.get("events") or []
+    spans = bundle.get("spans") or []
+    instants = bundle.get("instants") or []
+    fed = bundle.get("federation") or {}
+    lines = [
+        f"postmortem: {bundle['reason']}",
+        f"  trace_id:    {bundle.get('trace_id')}",
+        f"  extra:       {json.dumps(bundle.get('extra') or {}, sort_keys=True)}",
+        f"  ring events: {len(events)} "
+        f"({dict(Counter(e.get('kind') for e in events))})",
+        f"  spans:       {len(spans)} "
+        f"({dict(Counter(s.get('name') for s in spans))})",
+        f"  instants:    {len(instants)} "
+        f"({dict(Counter(i.get('name') for i in instants))})",
+        f"  federation:  {sorted(fed)}",
+        f"  guard:       "
+        f"{'yes (' + str((bundle['guard'].get('verdict') or {}).get('kind')) + ')' if bundle.get('guard') else 'no'}",
+    ]
+    slo_snap = fed.get("slo") or {}
+    if slo_snap.get("objectives_ms"):
+        lines.append(
+            f"  slo:         {slo_snap.get('breaches', 0)} breach(es) "
+            f"of {slo_snap.get('observed', 0)} observed, "
+            f"max burn {slo_snap.get('max_burn', 0.0)}"
+        )
+    print("\n".join(lines))
+
+    if not ns.trace:
+        return 0
+    try:
+        with open(ns.trace) as fh:
+            trace = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"postmortem: {ns.trace}: {e}", file=sys.stderr)
+        return 2
+    by_qid: dict = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X" or ev.get("name") != "serve_query":
+            continue
+        qid = (ev.get("args") or {}).get("query_id")
+        if qid is not None:
+            by_qid.setdefault(qid, []).append(
+                json.dumps(ev, sort_keys=True)
+            )
+    matched = mismatched = missing = 0
+    for row in spans:
+        if row.get("name") != "serve_query":
+            continue
+        qid = (row.get("args") or {}).get("query_id")
+        want = json.dumps(row, sort_keys=True)
+        cands = by_qid.get(qid, [])
+        if want in cands:
+            matched += 1
+        elif cands:
+            mismatched += 1
+        else:
+            missing += 1
+    print(f"trace cross-check: {matched} serve_query row(s) "
+          f"byte-matched, {mismatched} mismatched, {missing} absent "
+          f"from the trace")
+    return 1 if (mismatched or missing) else 0
+
+
 def main(argv=None):
     import sys
 
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
         return serve_main(argv[1:])
+    if argv and argv[0] == "postmortem":
+        return postmortem_main(argv[1:])
     if argv and argv[0] == "lint":
         # returned (not sys.exit'd) so programmatic callers get the
         # code; the module tail exits with it

@@ -99,6 +99,13 @@ class FleetStats:
 #: process (like PUMP_STATS): tests/bench read it, reset() between runs
 FLEET_STATS = FleetStats()
 
+# federated as "fleet" (obs/federation.py): the class keeps its own
+# snapshot()/reset() protocol; the federation just routes to it
+from libgrape_lite_tpu.obs import federation as _federation  # noqa: E402
+
+_federation.register("fleet", FLEET_STATS.snapshot, FLEET_STATS.reset,
+                     module=__name__)
+
 
 # ---- footprint pricing ----------------------------------------------------
 

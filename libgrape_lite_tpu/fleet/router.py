@@ -107,6 +107,13 @@ class FleetRouter:
 
     def _check_fence(self, r: Replica) -> None:
         if r.version != self.fence:
+            from libgrape_lite_tpu.obs.recorder import RECORDER
+
+            RECORDER.trigger(
+                "fence_violation",
+                extra={"replica": r.idx, "replica_version": r.version,
+                       "fence": self.fence},
+            )
             raise FenceViolationError(
                 f"replica {r.idx} is routable at graph version "
                 f"{r.version} but the fence is {self.fence} — "

@@ -60,11 +60,14 @@ VC2D_APPS = {
     "pagerank": "pagerank_vc",
 }
 
-PARTITION_STATS = {
+# federated as "partition" (obs/federation.py); mutation sites unchanged
+from libgrape_lite_tpu.obs.federation import FederatedStats as _FedStats
+
+PARTITION_STATS = _FedStats("partition", {
     "resolved_2d": 0,     # decisions that engaged the 2-D path
     "declined": 0,        # 2d/auto requested but ineligible or priced out
     "last_decision": None,
-}
+})
 
 
 # one set of padding helpers: the modeled vp/capacity terms below

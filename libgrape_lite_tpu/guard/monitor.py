@@ -316,6 +316,14 @@ class GuardMonitor:
             policy=self.config.policy,
             detail=verdict.get("detail", ""),
         )
+        from libgrape_lite_tpu.obs.recorder import RECORDER
+
+        RECORDER.trigger(
+            "guard_breach",
+            extra={"kind": verdict["kind"], "round": rounds,
+                   "policy": self.config.policy},
+            guard=bundle,
+        )
         msg = (
             f"guard: {verdict['kind']} breach at superstep {rounds} "
             f"(policy={self.config.policy}): {verdict['detail']}"

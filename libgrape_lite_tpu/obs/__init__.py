@@ -19,8 +19,17 @@ lowered HLO is byte-identical disarmed vs armed (pinned by test).
 Arming: GRAPE_TRACE=/path/trace.json, GRAPE_METRICS=/path/metrics
 (env, read once lazily), `--trace`/`--metrics` (CLI), or
 `obs.configure(...)` (API).
+
+The telemetry plane (PR 15) layers four always-on surfaces on top:
+`federation` (one namespaced snapshot()/reset() over every *_STATS
+registry), `exporter` (live OpenMetrics HTTP endpoint, armed via
+GRAPE_METRICS_PORT / --metrics_port), `slo` (latency objectives with
+error-budget burn; breach = instant + counter, never an exception),
+and `recorder` (a flight-recorder ring dumping correlated postmortem
+bundles on guard breach / fence violation / deadline storm).
 """
 
+from libgrape_lite_tpu.obs import federation
 from libgrape_lite_tpu.obs.config import (
     METRICS_ENV,
     TRACE_ENV,
@@ -33,18 +42,38 @@ from libgrape_lite_tpu.obs.config import (
     trace_id,
     tracer,
 )
+from libgrape_lite_tpu.obs.exporter import (
+    METRICS_PORT_ENV,
+    MetricsExporter,
+    maybe_start_from_env,
+    start_exporter,
+    stop_exporter,
+)
 from libgrape_lite_tpu.obs.export import (
     load_trace,
     rollup,
     write_chrome_trace,
 )
+from libgrape_lite_tpu.obs.federation import FederatedStats
+from libgrape_lite_tpu.obs import slo
 from libgrape_lite_tpu.obs.metrics import (
     NULL_METRICS,
     MetricsRegistry,
 )
+from libgrape_lite_tpu.obs.recorder import RECORDER, FlightRecorder
 from libgrape_lite_tpu.obs.tracer import NULL_SPAN, Span, Tracer
 
 __all__ = [
+    "federation",
+    "slo",
+    "FederatedStats",
+    "METRICS_PORT_ENV",
+    "MetricsExporter",
+    "maybe_start_from_env",
+    "start_exporter",
+    "stop_exporter",
+    "RECORDER",
+    "FlightRecorder",
     "METRICS_ENV",
     "TRACE_ENV",
     "armed",

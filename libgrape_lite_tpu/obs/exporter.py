@@ -1,0 +1,212 @@
+"""Live OpenMetrics exporter — scrape a running fleet without
+stopping it.
+
+A background-thread stdlib ``http.server`` endpoint, armed via
+``GRAPE_METRICS_PORT`` or the serve CLI's ``--metrics_port``:
+
+* ``/metrics`` — Prometheus text exposition: the armed
+  ``MetricsRegistry`` (obs/metrics.py, empty when disarmed) plus the
+  federation snapshot flattened to ``grape_stats_<ns>_<field>`` gauges
+  (dict-valued fields become one ``{key="..."}``-labelled sample per
+  entry; non-numeric fields are JSON-only).  Every registered
+  namespace is guaranteed a ``grape_stats_registry{namespace="…"} 1``
+  marker regardless of its field types — the live-scrape smoke in
+  app_tests.sh checks exactly that every ``*_STATS`` surface shows up.
+* ``/federation`` — the raw federation snapshot as JSON (the full
+  truth, including lists and last-decision records).
+* ``/healthz`` — liveness, ``{"ok": true, "namespaces": N}``.
+
+The server is a daemon thread off the serving path: a scrape costs
+the serving loop nothing but the GIL slices the snapshot copy takes.
+Port 0 binds an ephemeral port (tests); the bound port is readable
+from ``MetricsExporter.port``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from libgrape_lite_tpu.obs import federation
+
+METRICS_PORT_ENV = "GRAPE_METRICS_PORT"
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(ns: str, field: str) -> str:
+    return "grape_stats_%s_%s" % (
+        _NAME_OK.sub("_", ns), _NAME_OK.sub("_", field),
+    )
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _fmt_num(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def federation_text(snap=None) -> str:
+    """The federation snapshot in Prometheus/OpenMetrics text form.
+
+    Numeric scalars export directly; dict-valued fields with numeric
+    values export one labelled sample per key; every namespace gets
+    its ``grape_stats_registry`` marker even when no field is
+    exportable (a scrape must name every registered surface).
+    """
+    if snap is None:
+        snap = federation.snapshot()
+    lines = []
+    lines.append("# TYPE grape_stats_registry gauge")
+    for ns in sorted(snap):
+        lines.append(
+            'grape_stats_registry{namespace="%s"} 1' % _escape_label(ns)
+        )
+    for ns in sorted(snap):
+        for field in sorted(snap[ns]):
+            v = snap[ns][field]
+            name = _metric_name(ns, field)
+            if isinstance(v, bool) or isinstance(v, (int, float)):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {_fmt_num(v)}")
+            elif isinstance(v, dict):
+                numeric = {
+                    k: x for k, x in v.items()
+                    if isinstance(x, (int, float))
+                }
+                if numeric:
+                    lines.append(f"# TYPE {name} gauge")
+                    for k in sorted(numeric):
+                        lines.append(
+                            '%s{key="%s"} %s' % (
+                                name, _escape_label(str(k)),
+                                _fmt_num(numeric[k]),
+                            )
+                        )
+            # lists / strings / None: JSON endpoint only
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "grape-exporter/1"
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        try:
+            if path in ("/metrics", "/"):
+                from libgrape_lite_tpu import obs
+
+                text = obs.metrics().to_prometheus_text()
+                text += federation_text()
+                text += "# EOF\n"
+                self._send(200, text.encode("utf-8"),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/federation":
+                body = json.dumps(
+                    federation.snapshot(), indent=1, sort_keys=True,
+                    default=str,
+                ).encode("utf-8")
+                self._send(200, body, "application/json")
+            elif path == "/healthz":
+                body = json.dumps({
+                    "ok": True,
+                    "namespaces": len(federation.registered()),
+                }).encode("utf-8")
+                self._send(200, body, "application/json")
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except Exception as e:  # a scrape must never kill the server
+            self._send(500, f"{type(e).__name__}: {e}\n".encode(),
+                       "text/plain")
+
+    def log_message(self, fmt, *args):  # silence per-request stderr
+        pass
+
+
+class MetricsExporter:
+    """Background OpenMetrics endpoint over the federation + registry."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="grape-metrics-exporter", daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._server.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+_exporter: Optional[MetricsExporter] = None
+_exporter_lock = threading.Lock()
+
+
+def start_exporter(port: int = 0) -> MetricsExporter:
+    """Start (or return the already-running) module exporter."""
+    global _exporter
+    with _exporter_lock:
+        if _exporter is None:
+            _exporter = MetricsExporter(port=port)
+        return _exporter
+
+
+def get_exporter() -> Optional[MetricsExporter]:
+    return _exporter
+
+
+def stop_exporter() -> None:
+    global _exporter
+    with _exporter_lock:
+        if _exporter is not None:
+            _exporter.stop()
+            _exporter = None
+
+
+def maybe_start_from_env() -> Optional[MetricsExporter]:
+    """Arm from GRAPE_METRICS_PORT when set (the env twin of
+    --metrics_port); invalid values are ignored, not fatal — a bad
+    env var must not take down a serving process."""
+    raw = os.environ.get(METRICS_PORT_ENV)
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        return None
+    if port < 0:
+        return None
+    try:
+        return start_exporter(port)
+    except OSError:
+        return None

@@ -1,0 +1,179 @@
+"""Per-app / per-tenant latency SLOs with error-budget burn.
+
+Objectives are p99-style latency targets in milliseconds, declared via
+``GRAPE_SLO`` (or the serve CLI's ``--slo``) as a comma list:
+
+    GRAPE_SLO="sssp=5,bfs=10,tenant:t0=50,*=100"
+
+Keys resolve most-specific-first: ``tenant:<name>`` beats the app
+key, the app key beats ``*``.  A query *breaches* when it failed or
+its latency exceeded its objective.  A breach is **a traced instant
+plus a federated counter, never an exception** — SLOs are a
+measurement, not a control path; the serving loop must not change
+behaviour because an objective exists.
+
+Error budget: with allowed breach fraction ``f`` (default 1%,
+``GRAPE_SLO_BUDGET``), the burn rate for a key is
+``breaches / (observed * f)`` — burn 1.0 means the budget is spent
+exactly as fast as it accrues; >1.0 means the objective is being
+missed faster than the budget allows.  ``SLO_STATS`` federates under
+the ``slo`` namespace, so burn is visible on a live ``/metrics``
+scrape (``grape_stats_slo_burn_by_key{key="sssp"}``).
+
+``observe()`` is the one hook, called from
+``AdmissionQueue.deliver`` — the single bookkeeping site shared by
+the synchronous loop, the async pump, and every fleet replica.  With
+no objectives configured it is one falsy-dict check.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from libgrape_lite_tpu.obs.federation import FederatedStats
+
+SLO_ENV = "GRAPE_SLO"
+SLO_BUDGET_ENV = "GRAPE_SLO_BUDGET"
+DEFAULT_BUDGET_FRAC = 0.01
+
+#: objective key -> latency objective (ms); "" when unconfigured
+_OBJECTIVES: Dict[str, float] = {}
+_BUDGET_FRAC = DEFAULT_BUDGET_FRAC
+
+SLO_STATS = FederatedStats("slo", {
+    "observed": 0,
+    "breaches": 0,
+    "budget_frac": DEFAULT_BUDGET_FRAC,
+    "observed_by_key": {},
+    "breaches_by_key": {},
+    "burn_by_key": {},
+    "objectives_ms": {},
+    "max_burn": 0.0,
+})
+
+
+def parse_spec(spec: str) -> Dict[str, float]:
+    """``"sssp=5,tenant:t0=50,*=100"`` -> {key: objective_ms}.
+
+    Bad entries raise ValueError — an SLO typo should fail the CLI
+    flag loudly at startup, not silently watch nothing.
+    """
+    out: Dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad SLO entry (want key=ms): {part!r}")
+        key, _, ms = part.partition("=")
+        key = key.strip()
+        try:
+            val = float(ms)
+        except ValueError:
+            raise ValueError(f"bad SLO objective (want ms): {part!r}")
+        if not key or val <= 0:
+            raise ValueError(f"bad SLO entry: {part!r}")
+        out[key] = val
+    return out
+
+
+def configure(spec: Optional[str] = None,
+              budget_frac: Optional[float] = None) -> None:
+    """Install objectives (None/"" clears).  Resets SLO_STATS so burn
+    counts against the new objectives only."""
+    global _BUDGET_FRAC
+    _OBJECTIVES.clear()
+    if spec:
+        _OBJECTIVES.update(parse_spec(spec))
+    if budget_frac is not None:
+        if not (0 < budget_frac <= 1):
+            raise ValueError(
+                f"SLO budget fraction out of (0, 1]: {budget_frac}")
+        _BUDGET_FRAC = budget_frac
+    SLO_STATS.reset()
+    SLO_STATS["budget_frac"] = _BUDGET_FRAC
+    SLO_STATS["objectives_ms"] = dict(_OBJECTIVES)
+
+
+def maybe_configure_from_env() -> bool:
+    """Arm from GRAPE_SLO / GRAPE_SLO_BUDGET when set."""
+    spec = os.environ.get(SLO_ENV)
+    if not spec:
+        return False
+    frac = None
+    raw = os.environ.get(SLO_BUDGET_ENV)
+    if raw:
+        try:
+            frac = float(raw)
+        except ValueError:
+            frac = None
+    configure(spec, budget_frac=frac)
+    return True
+
+
+def configured() -> bool:
+    return bool(_OBJECTIVES)
+
+
+def objective_for(app: str,
+                  tenant: Optional[str] = None) -> Optional[tuple]:
+    """(key, objective_ms) for the most specific matching objective,
+    or None: tenant:<t> > app > '*'."""
+    if tenant is not None:
+        key = f"tenant:{tenant}"
+        ms = _OBJECTIVES.get(key)
+        if ms is not None:
+            return key, ms
+    ms = _OBJECTIVES.get(app)
+    if ms is not None:
+        return app, ms
+    ms = _OBJECTIVES.get("*")
+    if ms is not None:
+        return "*", ms
+    return None
+
+
+def observe(app: str, tenant: Optional[str], latency_s: float,
+            ok: bool = True) -> None:
+    """Count one delivered query against its objective.  Never raises;
+    one falsy-dict check when no objectives are configured."""
+    if not _OBJECTIVES:
+        return
+    hit = objective_for(app, tenant)
+    if hit is None:
+        return
+    key, objective_ms = hit
+    latency_ms = latency_s * 1e3
+    SLO_STATS["observed"] += 1
+    by_obs = SLO_STATS["observed_by_key"]
+    by_obs[key] = by_obs.get(key, 0) + 1
+    breached = (not ok) or latency_ms > objective_ms
+    if breached:
+        SLO_STATS["breaches"] += 1
+        by_br = SLO_STATS["breaches_by_key"]
+        by_br[key] = by_br.get(key, 0) + 1
+    # burn = breaches / (observed * budget_frac); observed >= 1 here
+    burn = round(
+        SLO_STATS["breaches_by_key"].get(key, 0)
+        / (by_obs[key] * _BUDGET_FRAC), 4,
+    )
+    SLO_STATS["burn_by_key"][key] = burn
+    if burn > SLO_STATS["max_burn"]:
+        SLO_STATS["max_burn"] = burn
+    if breached:
+        from libgrape_lite_tpu import obs
+
+        obs.tracer().instant(
+            "slo_breach", key=key, app=app,
+            tenant=tenant if tenant is not None else "",
+            latency_ms=round(latency_ms, 3),
+            objective_ms=objective_ms, ok=ok, burn=burn,
+        )
+        obs.metrics().counter(
+            "grape_slo_breaches_total",
+            "queries past their SLO objective (or failed)",
+        ).inc()
+
+
+maybe_configure_from_env()

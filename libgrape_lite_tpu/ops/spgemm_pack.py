@@ -683,12 +683,15 @@ def _load_cached_plan(v, u, frag, thr, cfg) -> SpGemmPlan | None:
 # resolve-path counters + the decision/decline record.  `declines` and
 # `decisions` are bounded lists of structured records — every backend
 # request that does NOT engage spgemm leaves a trace here, never a
-# silent fallback.
-SPGEMM_STATS = {
+# silent fallback.  Federated as "spgemm" (obs/federation.py): a dict
+# subclass, so the mutation sites below are unchanged.
+from libgrape_lite_tpu.obs.federation import FederatedStats as _FedStats
+
+SPGEMM_STATS = _FedStats("spgemm", {
     "planned": 0, "frag_cache_hits": 0, "disk_cache_hits": 0,
     "auto_spgemm": 0, "auto_intersect": 0,
     "declines": [], "decisions": [],
-}
+})
 _STATS_CAP = 64
 
 

@@ -2429,7 +2429,13 @@ class PackDispatch:
 # per-fragment cache vs the on-disk plan cache vs the O(E log E)
 # planner.  serve/ pins "a session's second query performs ZERO pack
 # planning" on `planned` staying flat (tests/test_serve.py).
-PLAN_STATS = {"frag_cache_hits": 0, "disk_cache_hits": 0, "planned": 0}
+# Federated as "plan" (obs/federation.py): a dict subclass, so the
+# hot-path `PLAN_STATS[...] += 1` sites below are unchanged.
+from libgrape_lite_tpu.obs.federation import FederatedStats as _FedStats
+
+PLAN_STATS = _FedStats("plan", {
+    "frag_cache_hits": 0, "disk_cache_hits": 0, "planned": 0,
+})
 
 
 def plan_stats() -> dict:

@@ -97,13 +97,16 @@ PIPELINE_WINDOW_CALLEES = frozenset({"reduce", "round_update"})
 
 # resolve-path registry: the last pipeline decision + split stats, so
 # plan_stats()/trace_report can surface boundary-set sizes without
-# holding fragment references
-PIPELINE_STATS = {
+# holding fragment references.  Federated as "pipeline"
+# (obs/federation.py); mutation sites unchanged.
+from libgrape_lite_tpu.obs.federation import FederatedStats as _FedStats
+
+PIPELINE_STATS = _FedStats("pipeline", {
     "resolved": 0,        # plans built (engaged)
     "declined": 0,        # structurally eligible but below threshold/off
     "last_decision": None,
     "last_stats": None,
-}
+})
 
 
 def pipeline_mode() -> str:

@@ -46,6 +46,7 @@ with concurrent ingest.
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional
 
 from libgrape_lite_tpu import obs
@@ -118,6 +119,13 @@ class PumpStats:
 #: pack plan_stats counters): tests/bench read it, reset() between runs
 PUMP_STATS = PumpStats()
 
+# federated as "pump" (obs/federation.py): the class keeps its own
+# snapshot()/reset() protocol; the federation just routes to it
+from libgrape_lite_tpu.obs import federation as _federation  # noqa: E402
+
+_federation.register("pump", PUMP_STATS.snapshot, PUMP_STATS.reset,
+                     module=__name__)
+
 
 class PendingBatch:
     """One admitted batch inside the dispatch window: the popped
@@ -130,7 +138,8 @@ class PendingBatch:
     overlap whatever is executing."""
 
     __slots__ = ("batch", "mode", "results", "prepared", "dispatch",
-                 "reason", "t0_ns")
+                 "reason", "t0_ns", "t_admit_ns", "t_launch_ns",
+                 "disp_ns")
 
     def __init__(self, batch: List[QueryRequest], mode: str,
                  results: Optional[List[ServeResult]] = None,
@@ -142,6 +151,13 @@ class PendingBatch:
         self.dispatch = dispatch
         self.reason = reason
         self.t0_ns = 0
+        # stage stamps (host perf_counter_ns): window admission time,
+        # execution-launch time, and accumulated host dispatch work
+        # (prepare + launch enqueue) — the harvest stage turns these
+        # into each lane's window_wait/dispatch/device/harvest µs
+        self.t_admit_ns = 0
+        self.t_launch_ns = 0
+        self.disp_ns = 0
 
     @property
     def launched(self) -> bool:
@@ -253,6 +269,7 @@ class AsyncServePump:
 
     def _dispatch(self, batch: List[QueryRequest]) -> None:
         tr = obs.tracer()
+        t_admit = time.perf_counter_ns()
         with tr.span(
             "serve_dispatch", app=batch[0].app_key, batch=len(batch),
             window=self.window, inflight=len(self._inflight),
@@ -260,6 +277,8 @@ class AsyncServePump:
         ) as sp:
             pb = self._dispatch_stage(batch)
             sp.set(mode=pb.mode, reason=pb.reason)
+        pb.t_admit_ns = t_admit
+        pb.disp_ns = time.perf_counter_ns() - t_admit
         if tr.enabled:
             pb.t0_ns = sp.t0_ns
         self._inflight.append(pb)
@@ -309,11 +328,15 @@ class AsyncServePump:
             if launched >= self.launch_cap:
                 break
             if p.mode == "deferred" and p.dispatch is None:
+                t_l0 = time.perf_counter_ns()
                 try:
                     p.dispatch = p.prepared.launch()
                 except Exception as e:
                     self._fail_batch(p, e)
                     continue
+                t_l1 = time.perf_counter_ns()
+                p.disp_ns += t_l1 - t_l0
+                p.t_launch_ns = t_l1
                 launched += 1
 
     def _dispatch_stage(self, batch: List[QueryRequest]) -> PendingBatch:
@@ -428,8 +451,13 @@ class AsyncServePump:
         sess = self.session
         try:
             if pb.dispatch is None:
+                t_l0 = time.perf_counter_ns()
                 pb.dispatch = pb.prepared.launch()
+                t_l1 = time.perf_counter_ns()
+                pb.disp_ns += t_l1 - t_l0
+                pb.t_launch_ns = t_l1
             d = pb.dispatch.wait()
+            t_sync = time.perf_counter_ns()
         except Exception as e:
             # JAX async dispatch surfaces runtime failures at the
             # sync point — the same whole-batch containment the sync
@@ -473,10 +501,20 @@ class AsyncServePump:
                     r.ok = False
                     r.values = None
                     r.error = {"error": f"{type(e).__name__}: {e}"}
+        t_h1 = time.perf_counter_ns()
+        # window_wait overlaps the dispatch stage (admit -> launch
+        # includes host prepare time) — an attribution aid, not a
+        # partition; queue_wait is stamped at delivery by the queue.
+        stages = {
+            "window_wait_us": max(0, pb.t_launch_ns - pb.t_admit_ns) // 1000,
+            "dispatch_us": pb.disp_ns // 1000,
+            "device_us": max(0, t_sync - pb.t_launch_ns) // 1000,
+            "harvest_us": max(0, t_h1 - t_sync) // 1000,
+        }
+        for r in results:
+            r.stages = dict(stages)
         if tr.enabled:
-            import time as _time
-
-            now_ns = _time.perf_counter_ns()
+            now_ns = time.perf_counter_ns()
             for b, (req, res) in enumerate(zip(batch, results)):
                 # per-query lane attribution, dispatch -> harvest
                 tr.emit_span_raw(
@@ -484,7 +522,10 @@ class AsyncServePump:
                     dur_ns=max(0, now_ns - pb.t0_ns),
                     tid=tr.lane_tid(b), query_id=req.id,
                     app=req.app_key, lane=b, rounds=res.rounds,
-                    ok=res.ok,
+                    ok=res.ok, tenant=req.tenant or "",
+                    queue_wait_us=int(
+                        max(0.0, req.popped_s - req.submitted_s) * 1e6
+                    ),
                 )
         return results
 

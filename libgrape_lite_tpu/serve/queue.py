@@ -87,6 +87,9 @@ class QueryRequest:
     tenant: Optional[str] = None
     id: int = field(default_factory=lambda: next(_IDS))
     submitted_s: float = field(default_factory=time.perf_counter)
+    # stamped by _pop_ready when the request leaves the queue: the
+    # submit->pop interval is the per-request queue_wait_us stage
+    popped_s: float = 0.0
     result: Optional["ServeResult"] = None
 
     @property
@@ -110,14 +113,15 @@ class ServeResult:
 
     __slots__ = ("request_id", "app_key", "ok", "rounds",
                  "terminate_code", "error", "lane", "batch_size",
-                 "latency_s", "_values", "_values_fn")
+                 "latency_s", "stages", "_values", "_values_fn")
 
     def __init__(self, request_id: int, app_key: str, ok: bool,
                  values: Optional[np.ndarray] = None, rounds: int = 0,
                  terminate_code: int = 0, error: Optional[dict] = None,
                  lane: int = 0, batch_size: int = 1,
                  latency_s: float = 0.0,
-                 values_fn: Optional[Callable[[], np.ndarray]] = None):
+                 values_fn: Optional[Callable[[], np.ndarray]] = None,
+                 stages: Optional[dict] = None):
         self.request_id = request_id
         self.app_key = app_key
         self.ok = ok
@@ -127,6 +131,13 @@ class ServeResult:
         self.lane = lane  # position inside the dispatched batch
         self.batch_size = batch_size
         self.latency_s = latency_s  # submit -> result delivery
+        # stage decomposition of the latency (µs ints): queue_wait_us
+        # (submit->pop, per request) + window_wait_us / dispatch_us /
+        # device_us / harvest_us (batch-level, same for every lane of
+        # one dispatch).  deliver() fills queue_wait_us; the dispatch
+        # paths fill the rest — a failed request may carry a partial
+        # dict, never a missing one after delivery.
+        self.stages = stages
         self._values = values  # [fnum, vp] assembled
         self._values_fn = values_fn
 
@@ -215,6 +226,7 @@ class AdmissionQueue:
         with the recorded reason and rides out through take_expired().
         Caller holds the lock."""
         live: List[QueryRequest] = []
+        swept: List[int] = []
         for req in self._pending:
             if (req.deadline_s is not None
                     and now - req.submitted_s > req.deadline_s):
@@ -228,14 +240,34 @@ class AdmissionQueue:
                         "waited_s": round(waited, 6),
                     },
                     latency_s=waited,
+                    stages={"queue_wait_us": int(waited * 1e6)},
                 )
                 req.result = res
                 self._expired_out.append(res)
                 self.expired += 1
                 self.completed += 1
+                swept.append(req.id)
             else:
                 live.append(req)
         self._pending = live
+        if swept:
+            from libgrape_lite_tpu.obs.recorder import (
+                DEADLINE_STORM_THRESHOLD,
+                RECORDER,
+            )
+
+            RECORDER.record("deadline_expired", n=len(swept),
+                            ids=swept[:16])
+            if len(swept) >= DEADLINE_STORM_THRESHOLD:
+                # a deadline STORM — one sweep failing a window's
+                # worth of requests — is a postmortem trigger, not
+                # just a counter (recorder never raises; safe under
+                # the queue lock, it takes no queue locks itself)
+                RECORDER.trigger("deadline_storm", extra={
+                    "expired_in_sweep": len(swept),
+                    "request_ids": swept[:64],
+                    "pending": len(self._pending),
+                })
 
     def take_expired(self) -> List[ServeResult]:
         """Drain the deadline-expired results (pump/drain and the
@@ -299,6 +331,7 @@ class AdmissionQueue:
                  "admission queue",
         )
         for req in batch:
+            req.popped_s = t_pop
             wait = t_pop - req.submitted_s
             self.admission_waits.append(wait)
             hist.observe(wait)
@@ -316,9 +349,24 @@ class AdmissionQueue:
                 f"{len(batch)}-lane batch"
             )
         t_done = time.perf_counter()
+        from libgrape_lite_tpu.obs import slo
+
         for req, res in zip(batch, results):
             res.latency_s = t_done - req.submitted_s
+            st = res.stages
+            if st is None:
+                st = res.stages = {}
+            if "queue_wait_us" not in st and req.popped_s:
+                st["queue_wait_us"] = int(
+                    (req.popped_s - req.submitted_s) * 1e6
+                )
             req.result = res
+            # the ONE bookkeeping site shared by the sync loop, the
+            # async pump, and every fleet replica — so SLO accounting
+            # cannot drift between serving modes (no-op when no
+            # objectives are configured; never raises)
+            slo.observe(req.app_key, req.tenant, res.latency_s,
+                        res.ok)
         self.batch_hist[len(batch)] = (
             self.batch_hist.get(len(batch), 0) + 1
         )

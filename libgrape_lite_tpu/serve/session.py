@@ -379,6 +379,8 @@ class ServeSession:
                         dur_ns=sp.dur_ns, tid=tr.lane_tid(b),
                         query_id=req.id, app=req.app_key, lane=b,
                         rounds=res.rounds, ok=res.ok,
+                        tenant=req.tenant or "",
+                        queue_wait_us=self._queue_wait_us(req),
                     )
             return results
 
@@ -389,20 +391,59 @@ class ServeSession:
                 "serve_query", t0_ns=sp.t0_ns, dur_ns=sp.dur_ns,
                 tid=tr.lane_tid(0), query_id=batch[0].id,
                 app=batch[0].app_key, lane=0, rounds=res.rounds,
-                ok=res.ok,
+                ok=res.ok, tenant=batch[0].tenant or "",
+                queue_wait_us=self._queue_wait_us(batch[0]),
             )
         return [res]
 
+    @staticmethod
+    def _queue_wait_us(req: QueryRequest) -> int:
+        """submit->pop µs for one request (0 before the pop stamp)."""
+        if not req.popped_s:
+            return 0
+        return int((req.popped_s - req.submitted_s) * 1e6)
+
+    @staticmethod
+    def _exec_stages(w: Worker, total_ns: int) -> dict:
+        """Batch-level stage split of one synchronous dispatch, from
+        the worker's host stamps when the path decomposed (fused /
+        batched runners) — otherwise the whole execute is attributed
+        to dispatch_us (guarded/stepwise/host paths run host work and
+        device chunks interleaved; pretending to split them would be
+        a made-up number, not a measurement)."""
+        st = w.last_stage_ns
+        if st is not None:
+            return {
+                "window_wait_us": 0,
+                "dispatch_us": st["dispatch"] // 1000,
+                "device_us": st["device"] // 1000,
+            }
+        return {
+            "window_wait_us": 0,
+            "dispatch_us": total_ns // 1000,
+            "device_us": 0,
+        }
+
     def _run_single(self, w: Worker, req: QueryRequest,
                     guard) -> ServeResult:
+        import time as _time
+
         from libgrape_lite_tpu.guard.monitor import GuardError
 
         try:
+            t0 = _time.perf_counter_ns()
             w.query(req.max_rounds, guard=guard, **req.args)
+            t_exec = _time.perf_counter_ns()
+            vals = w.result_values()
+            stages = self._exec_stages(w, t_exec - t0)
+            stages["harvest_us"] = (
+                _time.perf_counter_ns() - t_exec
+            ) // 1000
             return ServeResult(
                 request_id=req.id, app_key=req.app_key, ok=True,
-                values=w.result_values(), rounds=w.rounds,
+                values=vals, rounds=w.rounds,
                 terminate_code=w._terminate_code, batch_size=1,
+                stages=stages,
             )
         except GuardError as e:
             self.stats["failed"] += 1
@@ -420,10 +461,14 @@ class ServeSession:
 
     def _run_batched(self, w: Worker, batch: List[QueryRequest],
                      mr, guard) -> List[ServeResult]:
+        import time as _time
+
         try:
+            t0 = _time.perf_counter_ns()
             w.query_batch(
                 [req.args for req in batch], mr, guard=guard
             )
+            t_exec = _time.perf_counter_ns()
         except Exception as e:  # whole-batch failure: every lane errors
             self.stats["failed"] += len(batch)
             return [
@@ -434,6 +479,7 @@ class ServeSession:
                 )
                 for b, req in enumerate(batch)
             ]
+        stages = self._exec_stages(w, t_exec - t0)
         results = []
         breaches = w.batch_breaches or [None] * len(batch)
         for b, req in enumerate(batch):
@@ -443,6 +489,7 @@ class ServeSession:
                     request_id=req.id, app_key=req.app_key, ok=False,
                     error=breaches[b], rounds=int(w.batch_rounds[b]),
                     lane=b, batch_size=len(batch),
+                    stages=dict(stages),
                 ))
             else:
                 results.append(ServeResult(
@@ -451,5 +498,11 @@ class ServeSession:
                     rounds=int(w.batch_rounds[b]),
                     terminate_code=int(w.batch_terminate[b]),
                     lane=b, batch_size=len(batch),
+                    stages=dict(stages),
                 ))
+        # per-lane extraction happened inside the loop above: the
+        # batch-level harvest stage is the whole post-sync interval
+        harvest_us = (_time.perf_counter_ns() - t_exec) // 1000
+        for r in results:
+            r.stages["harvest_us"] = harvest_us
         return results

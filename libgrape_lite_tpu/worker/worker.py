@@ -283,6 +283,14 @@ class Worker:
         self.batch_rounds = None  # per-lane rounds of the last query_batch
         self.batch_terminate = None  # per-lane terminate codes (min(0, v))
         self.batch_breaches = None  # per-lane guard bundles (serve/batch)
+        # host-side stage decomposition of the last fused/batched
+        # query: {"dispatch": ns, "device": ns} — perf_counter_ns
+        # stamps around the runner enqueue and the result sync, so the
+        # serve stage report (queue.ServeResult.stages) can split host
+        # dispatch from device wait without touching the jitted
+        # program (None on paths that do not decompose: guarded,
+        # stepwise, host-only)
+        self.last_stage_ns = None
         # dyn/: incremental-IncEval accounting — seeded vs (counted,
         # never silent) cold fallbacks, and the last query's plan
         self.inc_stats = {"seeded": 0, "cold": 0}
@@ -952,6 +960,7 @@ class Worker:
         frag = self.fragment
         mr = app.max_rounds if max_rounds is None else max_rounds
         self._guard_monitor = None
+        self.last_stage_ns = None
 
         from libgrape_lite_tpu.guard.config import GuardConfig
 
@@ -961,6 +970,9 @@ class Worker:
 
             return run_guarded_batch(self, args_list, mr, guard_cfg)
 
+        import time as _time
+
+        t_host0 = _time.perf_counter_ns()
         batch = len(args_list)
         state = self._place_state_batch(
             app.init_state_batch(frag, args_list)
@@ -976,10 +988,15 @@ class Worker:
                 out_state, rounds_v, active_v = runner(
                     frag.dev, carry, eph_part
                 )
+                t_enq = _time.perf_counter_ns()
                 sp.mark("dispatched")
                 out_state = jax.block_until_ready(out_state)
                 rv = np.asarray(rounds_v)
                 av = np.asarray(active_v)
+                self.last_stage_ns = {
+                    "dispatch": t_enq - t_host0,
+                    "device": _time.perf_counter_ns() - t_enq,
+                }
                 self.batch_rounds = rv
                 self.batch_terminate = np.minimum(0, av)
                 self.batch_breaches = [None] * batch
@@ -1111,6 +1128,7 @@ class Worker:
 
         app = self.app
         self._check_dyn_view()
+        self.last_stage_ns = None
         if checkpoint_every is not None or checkpoint_dir is not None:
             guard_cfg = GuardConfig.resolve(guard)
             if (
@@ -1200,6 +1218,9 @@ class Worker:
             # the fused while_loop cannot rebuild the fragment mid-loop
             return self.query_stepwise(max_rounds, **query_args)
 
+        import time as _time
+
+        t_host0 = _time.perf_counter_ns()
         state = self._place_state(
             self._seeded(app.init_state(frag, **query_args))
         )
@@ -1221,10 +1242,15 @@ class Worker:
                 out_state, rounds, active = runner(
                     frag.dev, carry, eph_part
                 )
+                t_enq = _time.perf_counter_ns()
                 sp.mark("dispatched")
                 out_state = jax.block_until_ready(out_state)
                 self.rounds = int(rounds)
                 self._terminate_code = min(0, int(active))
+                self.last_stage_ns = {
+                    "dispatch": t_enq - t_host0,
+                    "device": _time.perf_counter_ns() - t_enq,
+                }
                 if tr.enabled:
                     # PEval + one IncEval per counted round, all
                     # inside the single fused dispatch

@@ -140,6 +140,12 @@ echo "  OK (byte-identical to the 1-D edge-cut)"
 echo "== guard self-heal drill (corrupt_carry + rollback-replay) =="
 python scripts/fault_drill.py --self-heal --apps sssp,pagerank,wcc
 
+echo "== flight-recorder drill (fleet breach -> bundle byte-matches trace) =="
+# obs/recorder.py end-to-end: guard breaches under a 2-replica fleet
+# dump postmortem bundles; the newest bundle's serve_query span rows
+# must byte-match the Chrome trace's rows for the same query ids
+python scripts/fault_drill.py --postmortem
+
 echo "== obs trace + per-superstep report (stepwise SSSP, fnum=2) =="
 run 2 sssp --sssp_source=6 --profile \
   --trace "$OUT/trace.json" --metrics "$OUT/metrics"
@@ -169,6 +175,73 @@ assert rec["queries"] == 32 and rec["failed"] == 0, rec
 assert rec["apps"] == {"sssp": 24, "bfs": 8}, rec["apps"]
 assert sum(rec["batch_hist"].values()) >= 4, rec["batch_hist"]
 print(f"  OK (32 queries, {rec['qps']} q/s, hist {rec['batch_hist']})")
+EOF
+
+echo "== telemetry: live OpenMetrics scrape mid-serve + stages + SLO (fnum=2) =="
+# the obs/ plane through the real CLI: --metrics_port 0 binds an
+# ephemeral exporter (URL on stderr); the scrape runs WHILE the stream
+# is live and must name every federated namespace in OpenMetrics text
+# (docs/OBSERVABILITY.md); the summary must carry the per-stage
+# p50/p99 decomposition and the SLO error-budget block
+python -m libgrape_lite_tpu.cli serve \
+  --efile "$DS/p2p-31.e" --vfile "$DS/p2p-31.v" $PLATFORM_ARGS --fnum 2 \
+  --stream "$OUT/serve_stream.txt" --max_batch 8 --inflight 2 \
+  --metrics_port 0 --slo 'sssp=5000,*=5000' \
+  > "$OUT/tele_serve.json" 2> "$OUT/tele_serve.err" &
+TELE_PID=$!
+URL=""
+for _ in $(seq 1 200); do
+  URL=$(sed -n 's/.*metrics exporter: \(http[^ ]*\).*/\1/p' "$OUT/tele_serve.err" | head -1)
+  [ -n "$URL" ] && break
+  sleep 0.05
+done
+[ -n "$URL" ] || { echo "EXPORTER URL NEVER PRINTED" >&2; kill "$TELE_PID"; exit 1; }
+python - "$URL" "$TELE_PID" <<'EOF'
+import json, os, sys, time, urllib.request
+url, pid = sys.argv[1], int(sys.argv[2])
+# poll until the SLO ledger shows deliveries, so the scrape is a
+# genuine mid-serve one (the all-8-namespace scrape is bench.py's
+# telemetry lane; HERE the contract is consistency: everything the
+# process has federated so far must be named in the OpenMetrics text)
+fed, observed = {}, 0
+for _ in range(600):
+    try:
+        fed = json.load(
+            urllib.request.urlopen(url + "/federation", timeout=10))
+    except OSError:
+        break
+    observed = (fed.get("slo") or {}).get("observed", 0)
+    if observed >= 1 or not os.path.exists(f"/proc/{pid}"):
+        break
+    time.sleep(0.05)
+assert observed >= 1, \
+    f"serve ended before a delivery was ever scraped: {sorted(fed)}"
+assert {"pump", "recorder", "slo"} <= set(fed), sorted(fed)
+text = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+live = os.path.exists(f"/proc/{pid}")
+missing = [ns for ns in fed
+           if f'grape_stats_registry{{namespace="{ns}"}}' not in text]
+assert not missing, f"scrape missing namespaces: {missing}"
+assert "grape_stats_slo_observed" in text, text[-400:]
+assert text.endswith("# EOF\n"), "scrape is not OpenMetrics-terminated"
+print(f"  OK ({'mid' if live else 'post'}-serve scrape at "
+      f"{observed} deliveries named all {len(fed)} live namespace(s): "
+      f"{sorted(fed)})")
+EOF
+wait "$TELE_PID"
+python - "$OUT/tele_serve.json" <<'EOF'
+import json, sys
+rec = json.loads(
+    [l for l in open(sys.argv[1]) if l.startswith("{")][-1])
+assert rec["queries"] == 32 and rec["failed"] == 0, rec
+st = rec["stages"]
+assert {"queue_wait_us", "dispatch_us", "device_us",
+        "harvest_us"} <= set(st), st
+assert all(set(v) == {"p50", "p99"} for v in st.values()), st
+slo = rec["slo"]
+assert slo["observed"] == 32 and slo["breaches"] == 0, slo
+print(f"  OK (stages {sorted(st)}; slo {slo['observed']} observed, "
+      f"{slo['breaches']} breach(es))")
 EOF
 
 echo "== dyn: ingest a delta stream while a mixed query stream runs (fnum=2) =="
@@ -275,7 +348,7 @@ print(f"  OK (cmp-identical; fence={fl['router']['fence']}, "
 EOF
 
 echo "== grape-lint: static contract rules, zero unsuppressed findings =="
-# the AST gate (R1-R7, analysis/): exits 1 on any finding the
+# the AST gate (R1-R8, analysis/): exits 1 on any finding the
 # baseline does not name, 3 if the --json record drifts from its own
 # declared schema — both fail this harness (set -e)
 python scripts/grape_lint.py --json > "$OUT/lint.json"
@@ -301,6 +374,39 @@ for app in ("sssp", "bfs"):
     qps = {k: v["qps"] for k, v in sv[app].items()}
     assert all(v["ok"] == v["n"] for v in sv[app].values()), sv[app]
     print(f"  serve {app}: qps {qps}")
+tel = rec["telemetry"]
+assert tel["federation_ok"] and tel["scrape_ok"], tel
+assert tel["namespaces"] >= 6, tel
+assert {"queue_wait_us", "dispatch_us", "device_us",
+        "harvest_us"} <= set(tel["stages"]), tel
+print(f"  telemetry: {tel['namespaces']} namespaces federated, "
+      f"live scrape ok, {len(tel['stages'])} stages")
 EOF
+
+echo "== bench_compare: declaration-driven regression gate =="
+# satellite of the schema gate (scripts/bench_compare.py): identical
+# records gate zero regressions, the archived full-scale r05 record
+# SKIPS (config guards) instead of false-failing against a scale-10
+# run, and a seeded 2x regression must exit 2
+python scripts/bench_compare.py "$OUT/bench.json" "$OUT/bench.json" > /dev/null
+python scripts/bench_compare.py "$OUT/bench.json" BENCH_r05.json > /dev/null
+python - "$OUT/bench.json" > "$OUT/bench_regressed.json" <<'EOF'
+import json, sys
+rec = json.loads(
+    [l for l in open(sys.argv[1]) if l.startswith("{")][-1])
+rec["value"] *= 0.5                            # halve the headline MTEPS
+rec["telemetry"]["stages"]["device_us"]["p99"] *= 10.0
+json.dump(rec, sys.stdout)
+EOF
+set +e
+python scripts/bench_compare.py "$OUT/bench.json" "$OUT/bench_regressed.json" \
+  > "$OUT/bench_cmp.txt" 2>&1
+BC_RC=$?
+set -e
+test "$BC_RC" -eq 2 \
+  || { echo "SEEDED REGRESSION NOT GATED (rc=$BC_RC)" >&2; cat "$OUT/bench_cmp.txt"; exit 1; }
+grep -q "REGRESSION" "$OUT/bench_cmp.txt"
+grep -q "telemetry.stages.device_us.p99" "$OUT/bench_cmp.txt"
+echo "  OK (self-compare clean, archived r05 skipped-not-failed, seeded 2x regression exits 2)"
 
 echo "ALL APP TESTS PASSED"

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Diff two BENCH json records against the declared schema and gate
+on perf regressions.
+
+`check_bench_schema.py` pins the SHAPE of a bench record; this tool
+pins its TRAJECTORY: given a baseline record and a candidate record
+(driver wrappers, bare records, or bench stdout — the same loader),
+it walks the schema's own block declarations (`_BLOCKS` /
+`_TOP_SCALARS` — nothing is compared that is not declared) and
+
+* compares every numeric field whose DIRECTION is known (wall
+  seconds, latency ms, overhead pct and recount mismatches are
+  lower-better; qps, MTEPS value, updates/s and speedups are
+  higher-better — config ints like scale/fnum/cadence are identity
+  guards, not metrics);
+* refuses to compare what is not comparable: a block whose config
+  fields (scale, app, fnum, metric, ...) differ between the two
+  records is skipped and REPORTED — a scale-10 CI record diffed
+  against the full-scale BENCH_r*.json gates nothing silently;
+* exits 2 when any gated field regresses by more than
+  --threshold-pct (default 10%), 0 otherwise — self-compare is
+  exactly 0 regressions by construction.
+
+Usage: python scripts/bench_compare.py BASELINE CANDIDATE
+           [--threshold-pct 10] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import check_bench_schema as cbs  # noqa: E402
+
+#: fields that pin what a block MEASURED — any mismatch makes the
+#: block incomparable (skipped + reported), never a regression
+_CONFIG_KEYS = {
+    "metric", "unit", "variant", "app", "mode", "policy",
+    "scan_mode", "planner_choice", "measured_winner", "auto_backend",
+    "scale", "bench_scale", "fnum", "k", "cadence", "probes",
+    "replicas", "tenants", "queries", "queries_per_app", "drain_at",
+    "drained_replica", "updates_per_chunk", "n",
+}
+
+#: leaf-name direction tables: the ONLY numeric fields the gate
+#: judges; anything else numeric is informational
+_LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us", "_pct", "_mismatch")
+_LOWER_BETTER = {
+    "p50", "p99", "dropped", "evictions", "overlay_recompiles",
+    "readmit_compiles",
+}
+_HIGHER_BETTER = {
+    "value", "vs_baseline", "qps", "updates_per_s", "qps_win_b8",
+    "inc_speedup",
+}
+
+
+def _direction(leaf: str) -> int:
+    """-1 = lower is better, +1 = higher is better, 0 = ungated."""
+    if leaf in _CONFIG_KEYS:
+        return 0
+    if leaf in _HIGHER_BETTER:
+        return +1
+    if leaf in _LOWER_BETTER or leaf.endswith(_LOWER_BETTER_SUFFIXES):
+        return -1
+    return 0
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, cbs._NUM) and not isinstance(v, bool)
+
+
+def _walk(base, cand, prefix, rows, skipped):
+    """Recurse matched dict paths.  A config mismatch ANYWHERE in a
+    subtree skips that whole subtree (its numbers measured a
+    different experiment); missing-on-either-side numeric leaves are
+    reported but never gated."""
+    for k in base:
+        if k not in cand:
+            continue
+        b, c = base[k], cand[k]
+        path = f"{prefix}{k}"
+        if k in _CONFIG_KEYS and not isinstance(b, dict):
+            if b != c:
+                skipped.append(
+                    (prefix.rstrip(".") or "record",
+                     f"{k}: {b!r} != {c!r}")
+                )
+                return False
+    for k in base:
+        if k not in cand:
+            continue
+        b, c = base[k], cand[k]
+        path = f"{prefix}{k}"
+        if isinstance(b, dict) and isinstance(c, dict):
+            _walk(b, c, path + ".", rows, skipped)
+        elif _is_num(b) and _is_num(c):
+            d = _direction(k)
+            if d == 0:
+                continue
+            delta_pct = (
+                (c - b) / abs(b) * 100.0 if b != 0
+                else (0.0 if c == 0 else float("inf"))
+            )
+            rows.append({
+                "field": path,
+                "baseline": b,
+                "candidate": c,
+                "delta_pct": delta_pct,
+                # regression magnitude: positive = worse, in percent
+                "regress_pct": delta_pct * -d,
+            })
+    return True
+
+
+def compare(base: dict, cand: dict):
+    """(rows, skipped): gated-field comparisons + incomparable
+    subtrees.  Blocks come from the schema declaration, so a record
+    key outside `_BLOCKS`/`_TOP_SCALARS` is never compared — the same
+    single-declaration-point discipline the validator enforces."""
+    rows: list = []
+    skipped: list = []
+    top_base = {k: base[k] for k in cbs._TOP_SCALARS if k in base}
+    top_cand = {k: cand[k] for k in cbs._TOP_SCALARS if k in cand}
+    _walk(top_base, top_cand, "", rows, skipped)
+    for name in cbs._BLOCKS:
+        b, c = base.get(name), cand.get(name)
+        if isinstance(b, dict) and isinstance(c, dict):
+            _walk(b, c, name + ".", rows, skipped)
+        elif isinstance(b, dict) != isinstance(c, dict):
+            skipped.append((name, "present in only one record"))
+    return rows, skipped
+
+
+def _load(path: str) -> dict:
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    pairs = cbs._records_from_text(text, path)
+    return pairs[0][0]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.strip().splitlines()[0])
+    ap.add_argument("baseline", help="baseline BENCH json (wrapper, "
+                                     "record, or bench stdout)")
+    ap.add_argument("candidate", help="candidate BENCH json")
+    ap.add_argument("--threshold-pct", type=float, default=10.0,
+                    help="gated regression threshold in percent "
+                         "(default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the structured comparison instead of "
+                         "the table")
+    ns = ap.parse_args(argv)
+    try:
+        base = _load(ns.baseline)
+        cand = _load(ns.candidate)
+    except (OSError, ValueError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 1
+    for label, rec in (("baseline", base), ("candidate", cand)):
+        errors = cbs.validate_record(rec)
+        if errors:
+            # a malformed record must fail loudly, not diff garbage
+            print(f"bench_compare: {label} fails the bench schema "
+                  f"({len(errors)} error(s)):", file=sys.stderr)
+            for e in errors[:8]:
+                print(f"  - {e}", file=sys.stderr)
+            return 1
+    rows, skipped = compare(base, cand)
+    regressions = [
+        r for r in rows if r["regress_pct"] > ns.threshold_pct
+    ]
+    if ns.json:
+        print(json.dumps({
+            "threshold_pct": ns.threshold_pct,
+            "compared": rows,
+            "skipped": skipped,
+            "regressions": [r["field"] for r in regressions],
+        }))
+        return 2 if regressions else 0
+    print(f"bench_compare: {len(rows)} gated field(s), threshold "
+          f"{ns.threshold_pct:g}%")
+    for r in rows:
+        worse = r["regress_pct"] > ns.threshold_pct
+        mark = " REGRESSION" if worse else ""
+        print(f"  {r['field']:<44} {r['baseline']:>12g} -> "
+              f"{r['candidate']:>12g} ({r['delta_pct']:+.1f}%){mark}")
+    for where, why in skipped:
+        print(f"  [skip] {where}: not comparable ({why})")
+    if regressions:
+        print(f"FAIL: {len(regressions)} field(s) regressed "
+              f">{ns.threshold_pct:g}%")
+        return 2
+    print("OK: no gated regression")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -271,6 +271,30 @@ _FLEET_REPLICA = {
     "ok": (int, True),
 }
 
+# the r15 telemetry lane (obs/, docs/OBSERVABILITY.md): the serve
+# stream's per-stage latency decomposition (stage -> {p50_ms, p99_ms}
+# from ServeResult.stages), the stats-federation census (registered
+# namespace count + the self_check verdict), the SLO burn and the
+# flight-recorder counters.  `scrape_ok` is the live-exporter smoke:
+# an in-process scrape of /metrics named every federated namespace.
+_TELEMETRY = {
+    "namespaces": (int, True),
+    "federation_ok": (bool, True),
+    "scrape_ok": (bool, False),
+    "stages": (dict, True),
+    "slo_observed": (int, True),
+    "slo_breaches": (int, True),
+    "slo_max_burn": (_NUM, True),
+    "recorder_recorded": (int, True),
+    "recorder_dropped": (int, True),
+    "recorder_triggers": (int, True),
+}
+
+_STAGE_POINT = {
+    "p50": (_NUM, True),
+    "p99": (_NUM, True),
+}
+
 #: every nested block bench.py may emit — THE single declaration
 #: point; _TOP, SCHEMA, validate_record and the CLI listing all
 #: derive from it (self_check() pins the derivation)
@@ -286,6 +310,7 @@ _BLOCKS = {
     "partition2d": _PARTITION2D,
     "spgemm": _SPGEMM,
     "fleet": _FLEET,
+    "telemetry": _TELEMETRY,
 }
 
 _TOP = {**_TOP_SCALARS, **{k: (dict, False) for k in _BLOCKS}}
@@ -473,6 +498,14 @@ def validate_record(record) -> list:
                         f"serve_async.admission_wait_ms.{q}: expected "
                         f"number, got {type(v).__name__}"
                     )
+    tl = record.get("telemetry")
+    if isinstance(tl, dict) and isinstance(tl.get("stages"), dict):
+        for sname, point in tl["stages"].items():
+            where = f"telemetry.stages[{sname!r}]"
+            if not isinstance(point, dict):
+                errors.append(f"{where}: expected object")
+                continue
+            _check_block(point, _STAGE_POINT, where, errors)
     fl = record.get("fleet")
     if isinstance(fl, dict):
         pr = fl.get("per_replica")

@@ -34,11 +34,27 @@ guard/ closed loop, end-to-end through the real CLI:
   3. **verify** — the healed output must be byte-identical to the
      reference, and the log must show the breach + rollback markers.
 
+**postmortem** (`--postmortem`) — the flight-recorder loop (obs/
+recorder.py), end-to-end through the real `serve` CLI under the fleet:
+
+  1. **breach** — a 2-replica, 2-tenant serve run executes a mixed
+     24-query stream armed with `GRAPE_FT_FAULTS=corrupt_carry@K` and
+     `--guard halt`: every poisoned lane fails ALONE (breach
+     isolation), and each guard breach trips `RECORDER.trigger`,
+     dumping a postmortem bundle into the `GRAPE_POSTMORTEM` sink.
+  2. **verify** — the newest bundle must carry the guard forensics
+     plus buffered `serve_query` span rows, and
+     `cli postmortem <bundle> --trace <trace.json>` must prove every
+     bundle span row byte-matches the Chrome trace's row for the same
+     query id (bundles copy tracer history verbatim — any drift in
+     the export form is a correlation bug).
+
 Exit code 0 iff every app passes.  Usage:
 
     python scripts/fault_drill.py                 # kill/resume, 3 apps
     python scripts/fault_drill.py --apps sssp --corrupt
     python scripts/fault_drill.py --self-heal     # guard rollback drill
+    python scripts/fault_drill.py --postmortem    # flight-recorder drill
 """
 
 from __future__ import annotations
@@ -65,6 +81,7 @@ def run_cli(extra, env_overrides=None, timeout=600):
     env = dict(os.environ)
     env.pop("GRAPE_FT_FAULTS", None)
     env.pop("GRAPE_GUARD", None)  # ambient guards must not leak in
+    env.pop("GRAPE_POSTMORTEM", None)  # nor an ambient bundle sink
     env.update(env_overrides or {})
     cmd = [sys.executable, "-m", "libgrape_lite_tpu.cli"] + extra
     proc = subprocess.run(
@@ -227,6 +244,90 @@ def self_heal_drill(app: str, args, workdir: str) -> bool:
     return True
 
 
+def postmortem_drill(args, workdir: str) -> bool:
+    """Guard breaches under the fleet must dump flight-recorder
+    bundles whose serve_query span rows byte-match the Chrome trace."""
+    import glob
+    import json
+
+    wd = os.path.join(workdir, "postmortem")
+    os.makedirs(wd, exist_ok=True)
+    stream = os.path.join(wd, "stream.txt")
+    with open(stream, "w") as fh:
+        for i in range(16):
+            fh.write(f"sssp {6 + i}\n")
+        for i in range(8):
+            fh.write(f"bfs {6 + i}\n")
+    pm = os.path.join(wd, "pm")
+    trace = os.path.join(wd, "trace.json")
+
+    # --max_batch 1 pins the stepwise guarded lane (the corrupt_carry
+    # hook's path); halt policy = breach isolation, so every poisoned
+    # query fails alone and the stream still completes
+    rc, log = run_cli(
+        [
+            "serve",
+            "--efile", args.efile, "--vfile", args.vfile,
+            "--platform", "cpu", "--cpu_devices", str(args.cpu_devices),
+            "--fnum", "2", "--stream", stream, "--max_batch", "1",
+            "--guard", "halt", "--replicas", "2", "--tenants", "by_app",
+            "--trace", trace,
+        ],
+        env_overrides={
+            "GRAPE_FT_FAULTS": f"corrupt_carry@{args.corrupt_carry_at}",
+            "GRAPE_POSTMORTEM": pm,
+        },
+    )
+    if rc != 1:
+        print(f"[postmortem] FAIL: poisoned serve rc={rc} (expected 1: "
+              f"every lane breaches, the stream completes)\n{log}")
+        return False
+    if "invariant breach at superstep" not in log:
+        print(f"[postmortem] FAIL: no breach was ever detected\n{log}")
+        return False
+    try:
+        rec = json.loads(
+            [l for l in log.splitlines() if l.startswith("{")][-1])
+    except (IndexError, ValueError):
+        print(f"[postmortem] FAIL: serve wrote no summary record\n{log}")
+        return False
+    if rec["queries"] != 24 or rec["failed"] != 24:
+        print(f"[postmortem] FAIL: expected all 24 poisoned lanes to "
+              f"fail alone, got {rec['failed']}/{rec['queries']}")
+        return False
+
+    bundles = sorted(glob.glob(os.path.join(pm, "postmortem_*.json")))
+    if len(bundles) < 2:
+        print(f"[postmortem] FAIL: {len(bundles)} bundle(s) dumped, "
+              f"expected one per breach")
+        return False
+    newest = bundles[-1]
+    bundle = json.load(open(newest))
+    sq = [s for s in bundle.get("spans", [])
+          if s.get("name") == "serve_query"]
+    if not sq or not bundle.get("guard") or not bundle.get("federation"):
+        print(f"[postmortem] FAIL: newest bundle lacks serve_query "
+              f"spans / guard forensics / federation snapshot "
+              f"({len(sq)} spans)")
+        return False
+
+    rc, log = run_cli(["postmortem", newest, "--trace", trace])
+    if rc != 0:
+        print(f"[postmortem] FAIL: postmortem --trace rc={rc}\n{log}")
+        return False
+    if "0 mismatched, 0 absent" not in log:
+        print(f"[postmortem] FAIL: bundle span rows drifted from the "
+              f"Chrome trace\n{log}")
+        return False
+    print(
+        f"[postmortem] PASS: {len(bundles)} breach bundle(s) dumped "
+        f"under the 2-replica fleet; newest carries {len(sq)} "
+        f"serve_query row(s), every one byte-identical to the Chrome "
+        f"trace's row for the same query id"
+    )
+    return True
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--apps", default="",
@@ -249,7 +350,13 @@ def main() -> int:
                         "rollback-replay, and byte-identical results")
     p.add_argument("--corrupt_carry_at", type=int, default=4,
                    help="superstep for the corrupt_carry injection "
-                        "(--self-heal)")
+                        "(--self-heal / --postmortem)")
+    p.add_argument("--postmortem", action="store_true",
+                   help="flight-recorder drill: breach a 2-replica "
+                        "fleet serve stream under --guard halt with a "
+                        "GRAPE_POSTMORTEM sink and verify the dumped "
+                        "bundle's serve_query rows byte-match the "
+                        "Chrome trace")
     p.add_argument("--workdir", default="",
                    help="working directory (default: a fresh temp dir, "
                         "removed on success)")
@@ -259,10 +366,13 @@ def main() -> int:
         args.apps = "sssp,pagerank,wcc" if args.self_heal \
             else "sssp,pagerank,cdlp"
     workdir = args.workdir or tempfile.mkdtemp(prefix="grape-fault-drill-")
-    run_one = self_heal_drill if args.self_heal else drill
-    ok = True
-    for app in filter(None, args.apps.split(",")):
-        ok = run_one(app.strip(), args, workdir) and ok
+    if args.postmortem:
+        ok = postmortem_drill(args, workdir)
+    else:
+        run_one = self_heal_drill if args.self_heal else drill
+        ok = True
+        for app in filter(None, args.apps.split(",")):
+            ok = run_one(app.strip(), args, workdir) and ok
     if ok and not args.workdir:
         shutil.rmtree(workdir, ignore_errors=True)
     else:

@@ -33,6 +33,11 @@ GRAPE_TRACE / --trace / obs.configure and prints:
   batches were still in flight — and a PUMP DRIFT flag when a W>1
   window is armed but hides <10% of the harvest wall (the window is
   paying its bookkeeping and buying no overlap);
+* the per-query serve table when the trace carries serve_query lane
+  spans (r15): one row per query with its queue-wait column (the
+  submit->pop admission wait the session stamps on every span), plus
+  per-tenant and per-replica rollup rows (fleet_replica spans) so a
+  mixed-tenant fleet trace reads as one table;
 * a phase rollup (obs.rollup) for the non-superstep spans.
 
 Usage: python scripts/trace_report.py TRACE [--drift-x 2.0]
@@ -180,6 +185,108 @@ def serve_pump_rows(events):
             "window": ha.get("window", da.get("window", 1)),
         })
     return rows
+
+
+def serve_query_rows(events):
+    """One row per serve_query lane span, in (timestamp, lane) order:
+    the per-query view of a serve trace, carrying the queue-wait the
+    session stamped at emit time (submit->pop admission wait µs)."""
+    rows = []
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("name") != "serve_query":
+            continue
+        a = ev.get("args") or {}
+        rows.append({
+            "ts": float(ev.get("ts", 0)),
+            "wall_us": float(ev.get("dur", 0)),
+            "query_id": a.get("query_id", "?"),
+            "app": a.get("app", "?"),
+            "tenant": a.get("tenant", "") or "-",
+            "lane": a.get("lane", 0),
+            "rounds": a.get("rounds", 0),
+            "ok": a.get("ok", True),
+            "queue_wait_us": a.get("queue_wait_us"),
+        })
+    return sorted(rows, key=lambda r: (r["ts"], r["lane"]))
+
+
+def fleet_replica_rows(events):
+    """fleet_replica spans (fleet/router.py): one per replica pump
+    pass that delivered results, on the replica's own trace row."""
+    rows = []
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("name") != "fleet_replica":
+            continue
+        a = ev.get("args") or {}
+        rows.append({
+            "replica": a.get("replica", "?"),
+            "results": a.get("results", 0),
+            "wall_us": float(ev.get("dur", 0)),
+        })
+    return rows
+
+
+_QUERY_ROWS_CAP = 64
+
+
+def render_serve_queries(rows, replica_rows, out=sys.stdout):
+    """Per-query serve table with the queue-wait column, then the
+    per-tenant and per-replica rollup rows.  Percentiles follow
+    serve/queue.py latency_summary_ms (p50 = v[n//2])."""
+    if not rows and not replica_rows:
+        return
+
+    def _p50(v):
+        return v[len(v) // 2]
+
+    def _p99(v):
+        return v[min(len(v) - 1, int(len(v) * 0.99))]
+
+    if rows:
+        print("\nserve queries (serve_query lane spans; qwait = "
+              "submit->pop admission wait):", file=out)
+        print(f"{'qid':>6} {'app':>10} {'tenant':>8} {'lane':>5} "
+              f"{'rounds':>6} {'ok':>3} {'qwait_ms':>10} "
+              f"{'wall_ms':>10}", file=out)
+        for r in rows[:_QUERY_ROWS_CAP]:
+            print(
+                f"{str(r['query_id']):>6} {r['app']:>10} "
+                f"{r['tenant']:>8} {r['lane']:>5} {r['rounds']:>6} "
+                f"{'y' if r['ok'] else 'n':>3} "
+                f"{_fmt_ms(r['queue_wait_us'])} {_fmt_ms(r['wall_us'])}",
+                file=out,
+            )
+        if len(rows) > _QUERY_ROWS_CAP:
+            print(f"  ... {len(rows) - _QUERY_ROWS_CAP} more query "
+                  "row(s) elided (rollups below cover all of them)",
+                  file=out)
+        by_tenant: dict = {}
+        for r in rows:
+            by_tenant.setdefault(r["tenant"], []).append(r)
+        print("  per-tenant rollup:", file=out)
+        for t, rs in sorted(by_tenant.items()):
+            qw = sorted(float(x["queue_wait_us"] or 0) for x in rs)
+            wl = sorted(x["wall_us"] for x in rs)
+            print(
+                f"    tenant={t:<10} n={len(rs):<4} "
+                f"ok={sum(bool(x['ok']) for x in rs):<4} "
+                f"qwait p50={_p50(qw) / 1e3:.3f} "
+                f"p99={_p99(qw) / 1e3:.3f} "
+                f"wall p50={_p50(wl) / 1e3:.3f} "
+                f"p99={_p99(wl) / 1e3:.3f} ms", file=out,
+            )
+    if replica_rows:
+        by_rep: dict = {}
+        for r in replica_rows:
+            by_rep.setdefault(r["replica"], []).append(r)
+        print("  per-replica rollup (fleet_replica spans):", file=out)
+        for idx, rs in sorted(by_rep.items(), key=lambda kv: str(kv[0])):
+            print(
+                f"    replica={idx!s:<3} pumps={len(rs):<4} "
+                f"results={sum(x['results'] for x in rs):<5} "
+                f"pump wall={sum(x['wall_us'] for x in rs) / 1e3:.3f} ms",
+                file=out,
+            )
 
 
 def render_serve_pump(rows, out=sys.stdout) -> int:
@@ -342,6 +449,9 @@ def render(events, drift_x: float = DRIFT_X, out=sys.stdout):
                 file=out,
             )
     pump_flagged = render_serve_pump(serve_pump_rows(events), out)
+    render_serve_queries(
+        serve_query_rows(events), fleet_replica_rows(events), out
+    )
     if flagged:
         print(
             f"\n{flagged} superstep(s) drifted >{drift_x}x from the "

@@ -1,7 +1,7 @@
 """analysis/ — grape-lint: static contract linter + artifact auditor
 (ISSUE 8 acceptance).
 
-Pins: each AST rule R1-R5 trips on a known-bad fixture snippet and
+Pins: each AST rule R1-R8 trips on a known-bad fixture snippet and
 stays silent on the matching known-good one; the suppression baseline
 round-trips and is keyed by line-stable fingerprints; the artifact
 audits run on a REAL compiled SSSP runner (constant-bloat clean,
@@ -508,6 +508,100 @@ def test_r7_shipped_pump_is_clean():
         src = fh.read()
     r7 = [f for f in lint_source(src, _PUMP_PATH) if f.rule == "R7"]
     assert not r7, [f.message for f in r7]
+
+
+# ---- R8: module-level *_STATS surfaces must federate ----------------------
+
+
+def test_r8_trips_on_hand_rolled_stats_dict():
+    # the retired idiom: a raw module dict is invisible to
+    # federation.snapshot(), the live exporter, and every bundle
+    src = """
+    THING_STATS = {"planned": 0, "declines": []}
+
+    def plan():
+        THING_STATS["planned"] += 1
+    """
+    assert "R8" in _rules(src, "libgrape_lite_tpu/ops/thing.py")
+
+
+def test_r8_trips_on_ad_hoc_stats_class_instance():
+    src = """
+    class _Stats:
+        def snapshot(self):
+            return {}
+
+    THING_STATS = _Stats()
+    """
+    assert "R8" in _rules(src, "libgrape_lite_tpu/ops/thing.py")
+
+
+def test_r8_passes_federated_stats_ctor_under_alias():
+    src = """
+    from libgrape_lite_tpu.obs.federation import FederatedStats as _FedStats
+
+    THING_STATS = _FedStats("thing", {"planned": 0})
+    """
+    assert "R8" not in _rules(src, "libgrape_lite_tpu/ops/thing.py")
+
+
+def test_r8_passes_explicit_register_via_module_alias():
+    # the PumpStats/FleetStats form: a class instance is fine as long
+    # as its defining module registers it with the federation
+    src = """
+    from libgrape_lite_tpu.obs import federation as _federation
+
+    class _Stats:
+        def snapshot(self):
+            return {}
+
+    THING_STATS = _Stats()
+    _federation.register("thing", THING_STATS.snapshot, None,
+                         module=__name__)
+    """
+    assert "R8" not in _rules(src, "libgrape_lite_tpu/ops/thing.py")
+
+
+def test_r8_passes_lazy_function_level_register():
+    # registration behind a function-level import still counts — the
+    # rule asks WHETHER the module wires in, not where the import sits
+    src = """
+    THING_STATS = {"planned": 0}
+
+    def _wire():
+        from libgrape_lite_tpu.obs.federation import register
+        register("thing", lambda: dict(THING_STATS), None)
+
+    _wire()
+    """
+    assert "R8" not in _rules(src, "libgrape_lite_tpu/ops/thing.py")
+
+
+def test_r8_exempts_the_federation_module_itself():
+    src = """
+    SLO_STATS = {"observed": 0}
+    """
+    assert "R8" not in _rules(
+        src, "libgrape_lite_tpu/obs/federation.py")
+    assert "R8" in _rules(src, "libgrape_lite_tpu/obs/other.py")
+
+
+def test_r8_shipped_stats_surfaces_are_clean():
+    # zero-entry baseline over the real owners of every EXPECTED
+    # namespace: each *_STATS surface in the shipped tree federates
+    import os
+
+    import libgrape_lite_tpu
+    from libgrape_lite_tpu.obs.federation import EXPECTED
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(libgrape_lite_tpu.__file__)))
+    for owner in EXPECTED.values():
+        rel = owner.replace(".", "/") + ".py"
+        with open(os.path.join(root, rel)) as fh:
+            src = fh.read()
+        r8 = [f for f in lint_source(src, rel) if f.rule == "R8"]
+        assert not r8, (owner, [f.message for f in r8])
 
 
 # ---- baseline round-trip --------------------------------------------------

@@ -1,0 +1,522 @@
+"""The telemetry plane (ISSUE 15): stats federation, the live
+OpenMetrics exporter, per-query stage tracing, SLO error budgets, and
+the flight-recorder postmortem path.
+
+Pins: every EXPECTED namespace federates and self_check() is clean;
+FederatedStats snapshots are isolated copies and reset() restores the
+construction-time state; a live scrape names every registered
+namespace and the JSON/healthz endpoints agree with it; SLO parsing
+fails loudly, observation burns the error budget most-specific-first
+and NEVER raises; the recorder ring is bounded, triggers count without
+a sink and dump schema-valid bundles with one; every serving path
+(sync loop and async pump) stamps the five-stage decomposition onto
+its ServeResults; and the postmortem CLI renders bundles, rejects
+foreign schemas, and byte-matches bundle span rows against the Chrome
+trace.  bench_compare: self-compare gates nothing, a seeded regression
+exits 2, and incomparable configs skip instead of gating."""
+
+import json
+import sys
+import time
+
+import pytest
+
+from libgrape_lite_tpu import obs
+from libgrape_lite_tpu.obs import federation, slo
+from libgrape_lite_tpu.obs.recorder import (
+    BUNDLE_SCHEMA, REC_STATS, RECORDER, FlightRecorder,
+)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset(monkeypatch):
+    """Disarmed, un-SLO'd, sinkless before and after every test."""
+    monkeypatch.delenv(obs.TRACE_ENV, raising=False)
+    monkeypatch.delenv(obs.METRICS_ENV, raising=False)
+    monkeypatch.delenv(slo.SLO_ENV, raising=False)
+    monkeypatch.delenv("GRAPE_POSTMORTEM", raising=False)
+    obs.reset()
+    slo.configure(None)
+    RECORDER.set_sink(None)
+    yield
+    obs.reset()
+    slo.configure(None)
+    RECORDER.set_sink(None)
+
+
+# ---- federation ------------------------------------------------------------
+
+
+def test_federation_self_check_clean_and_complete():
+    """The wiring contract holds on the shipped tree: every EXPECTED
+    namespace registers at its owner's import with a JSON-clean
+    snapshot."""
+    assert federation.self_check() == []
+    assert set(federation.EXPECTED) <= set(federation.registered())
+    snap = federation.snapshot()
+    json.dumps(snap)  # the exporter's precondition
+    for ns in federation.EXPECTED:
+        assert isinstance(snap[ns], dict)
+
+
+def test_federated_stats_snapshot_isolation_and_reset():
+    st = federation.FederatedStats(
+        "t15_iso", {"n": 0, "hist": [], "by_key": {}}, register_=False)
+    st["n"] += 3
+    st["hist"].append(7)
+    st["by_key"]["a"] = 1
+    snap = st.snapshot()
+    # list/dict values are copies: mutating the snapshot never writes
+    # back into the live registry (and vice versa)
+    snap["hist"].append(99)
+    snap["by_key"]["b"] = 2
+    assert st["hist"] == [7] and st["by_key"] == {"a": 1}
+    st.reset()
+    assert st.snapshot() == {"n": 0, "hist": [], "by_key": {}}
+
+
+def test_federation_rejects_cross_module_namespace_claim():
+    federation.register("t15_claim", dict, module="tests.owner_a")
+    with pytest.raises(ValueError, match="already registered"):
+        federation.register("t15_claim", dict, module="tests.owner_b")
+    # same module re-registering (reload idiom) is fine
+    federation.register("t15_claim", dict, module="tests.owner_a")
+
+
+def test_federation_snapshot_single_namespace_and_unknown():
+    assert isinstance(federation.snapshot("recorder"), dict)
+    with pytest.raises(KeyError):
+        federation.snapshot("no_such_namespace")
+
+
+# ---- exporter --------------------------------------------------------------
+
+
+def test_exporter_scrape_names_every_registered_namespace():
+    import urllib.request
+
+    from libgrape_lite_tpu.obs import exporter
+
+    federation.self_check()  # import every owner first
+    exp = exporter.MetricsExporter(port=0)
+    try:
+        url = exp.url
+        text = urllib.request.urlopen(
+            url + "/metrics", timeout=10).read().decode()
+        assert text.endswith("# EOF\n")
+        for ns in federation.registered():
+            assert f'grape_stats_registry{{namespace="{ns}"}} 1' \
+                in text, ns
+        fed = json.load(
+            urllib.request.urlopen(url + "/federation", timeout=10))
+        assert sorted(fed) == federation.registered()
+        health = json.load(
+            urllib.request.urlopen(url + "/healthz", timeout=10))
+        assert health["ok"] and \
+            health["namespaces"] == len(federation.registered())
+        assert urllib.request.urlopen(
+            url + "/metrics", timeout=10).status == 200
+    finally:
+        exp.stop()
+
+
+def test_exporter_flattens_numeric_and_dict_fields():
+    from libgrape_lite_tpu.obs.exporter import federation_text
+
+    text = federation_text({
+        "t15": {"count": 3, "ratio": 0.5, "flag": True,
+                "by_key": {"a": 1, "b": 2.5}, "note": "json-only",
+                "hist": [1, 2]},
+    })
+    assert 'grape_stats_registry{namespace="t15"} 1' in text
+    assert "grape_stats_t15_count 3" in text
+    assert "grape_stats_t15_ratio 0.5" in text
+    assert "grape_stats_t15_flag 1" in text
+    assert 'grape_stats_t15_by_key{key="a"} 1' in text
+    assert 'grape_stats_t15_by_key{key="b"} 2.5' in text
+    # strings and lists stay JSON-endpoint-only
+    assert "note" not in text and "hist" not in text
+
+
+def test_exporter_start_is_idempotent_and_stoppable():
+    from libgrape_lite_tpu.obs import exporter
+
+    try:
+        a = exporter.start_exporter(0)
+        b = exporter.start_exporter(0)
+        assert a is b and a.port > 0
+    finally:
+        exporter.stop_exporter()
+    assert exporter.get_exporter() is None
+
+
+# ---- SLO -------------------------------------------------------------------
+
+
+def test_slo_parse_spec_and_loud_failures():
+    assert slo.parse_spec("sssp=5,tenant:t0=50,*=100") == {
+        "sssp": 5.0, "tenant:t0": 50.0, "*": 100.0,
+    }
+    for bad in ("sssp", "sssp=abc", "=5", "sssp=0", "sssp=-1"):
+        with pytest.raises(ValueError):
+            slo.parse_spec(bad)
+
+
+def test_slo_resolution_most_specific_first():
+    slo.configure("sssp=5,tenant:t0=50,*=100")
+    assert slo.objective_for("sssp", "t0") == ("tenant:t0", 50.0)
+    assert slo.objective_for("sssp", "t1") == ("sssp", 5.0)
+    assert slo.objective_for("bfs", None) == ("*", 100.0)
+
+
+def test_slo_breach_burns_budget_and_never_raises():
+    slo.configure("sssp=10,*=1000", budget_frac=0.5)
+    slo.observe("sssp", None, 0.001)            # 1ms: within objective
+    slo.observe("sssp", None, 5.0)              # 5000ms: breach
+    slo.observe("sssp", None, 0.001, ok=False)  # failure: breach
+    slo.observe("bfs", "t9", 0.001)             # '*' key, no breach
+    snap = slo.SLO_STATS.snapshot()
+    assert snap["observed"] == 4 and snap["breaches"] == 2
+    assert snap["observed_by_key"] == {"sssp": 3, "*": 1}
+    assert snap["breaches_by_key"] == {"sssp": 2}
+    # burn = breaches / (observed * frac) = 2 / (3 * 0.5)
+    assert snap["burn_by_key"]["sssp"] == pytest.approx(1.3333)
+    assert snap["max_burn"] == snap["burn_by_key"]["sssp"]
+    assert snap["objectives_ms"] == {"sssp": 10.0, "*": 1000.0}
+
+
+def test_slo_breach_is_instant_plus_counter_never_exception():
+    tr = obs.configure(in_memory=True)
+    slo.configure("sssp=0.0001")
+    slo.observe("sssp", "t0", 1.0)  # hopeless objective: must breach
+    names = [e["name"] for e in tr.events() if e["ph"] == "i"]
+    assert "slo_breach" in names
+    m = obs.metrics().snapshot()
+    assert m["grape_slo_breaches_total"]["value"] == 1
+
+
+def test_slo_disarmed_observe_is_noop_and_submicrosecond():
+    """observe() sits on AdmissionQueue.deliver for EVERY query; with
+    no objectives it must stay one falsy-dict check (same budget
+    discipline as the disarmed span)."""
+    assert not slo.configured()
+    before = slo.SLO_STATS.snapshot()
+    n = 50_000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            slo.observe("sssp", None, 0.001)
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert slo.SLO_STATS.snapshot() == before
+    assert best < 1e-6, f"disarmed observe costs {best * 1e9:.0f}ns"
+
+
+# ---- flight recorder -------------------------------------------------------
+
+
+def test_recorder_ring_is_bounded_and_counts_drops():
+    rec = FlightRecorder(capacity=4)
+    base_dropped = REC_STATS["dropped"]
+    for i in range(10):
+        rec.record("tick", i=i)
+    evs = rec.events()
+    assert len(evs) == 4
+    assert [e["i"] for e in evs] == [6, 7, 8, 9]
+    assert REC_STATS["dropped"] == base_dropped + 6
+
+
+def test_recorder_trigger_without_sink_counts_but_never_dumps():
+    rec = FlightRecorder()
+    before = REC_STATS["triggers"]
+    assert rec.trigger("unit_test_reason") is None
+    assert REC_STATS["triggers"] == before + 1
+    assert REC_STATS["last_reason"] == "unit_test_reason"
+
+
+def test_recorder_dump_is_schema_valid_and_correlated(tmp_path):
+    tr = obs.configure(in_memory=True)
+    with tr.span("serve_query", query_id=7):
+        pass
+    tr.instant("guard_breach", kind="invariant")
+    rec = FlightRecorder()
+    rec.set_sink(str(tmp_path))
+    rec.record("admission", qid=7)
+    path = rec.trigger("guard_breach", extra={"round": 3},
+                       guard={"verdict": {"kind": "invariant"}})
+    assert path is not None
+    bundle = json.load(open(path))
+    assert bundle["schema"] == BUNDLE_SCHEMA
+    assert bundle["trace_id"] == obs.trace_id()
+    assert bundle["extra"] == {"round": 3}
+    assert any(e["kind"] == "admission" for e in bundle["events"])
+    # span rows are the tracer's export-form dicts, verbatim
+    sq = [s for s in bundle["spans"] if s["name"] == "serve_query"]
+    want = [e for e in tr.events()
+            if e["ph"] == "X" and e["name"] == "serve_query"]
+    assert [json.dumps(s, sort_keys=True) for s in sq] == \
+        [json.dumps(e, sort_keys=True) for e in want]
+    assert "recorder" in bundle["federation"]
+
+
+def test_recorder_trigger_never_raises_on_bad_sink():
+    rec = FlightRecorder()
+    rec.set_sink("/proc/definitely/not/writable")
+    assert rec.trigger("whatever") is None  # swallowed, not raised
+
+
+def test_deadline_storm_trips_the_recorder():
+    from libgrape_lite_tpu.obs.recorder import DEADLINE_STORM_THRESHOLD
+    from libgrape_lite_tpu.serve.queue import AdmissionQueue
+
+    before = REC_STATS["triggers"]
+    q = AdmissionQueue(dispatch=lambda batch: [])
+    for i in range(DEADLINE_STORM_THRESHOLD + 1):
+        q.submit("sssp", {"source": i}, deadline_s=-1.0)
+    assert q._pop_ready(force=True) == []  # everything expired
+    assert REC_STATS["triggers"] == before + 1
+    assert REC_STATS["last_reason"] == "deadline_storm"
+    expired = q.take_expired()
+    assert len(expired) == DEADLINE_STORM_THRESHOLD + 1
+    assert all(not r.ok and
+               r.error["reason"] == "deadline_expired" and
+               "queue_wait_us" in r.stages for r in expired)
+
+
+# ---- per-query stage decomposition ----------------------------------------
+
+
+def _stage_keys():
+    return {"queue_wait_us", "window_wait_us", "dispatch_us",
+            "device_us", "harvest_us"}
+
+
+def test_sync_serve_results_carry_stage_decomposition(graph_cache):
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    sess = ServeSession(graph_cache(2),
+                        policy=BatchPolicy(max_batch=4))
+    results = sess.serve(
+        [("sssp", {"source": s}) for s in (6, 5229, 8200)])
+    assert all(r.ok for r in results)
+    for r in results:
+        assert set(r.stages) == _stage_keys(), r.stages
+        assert all(isinstance(v, int) and v >= 0
+                   for v in r.stages.values())
+        # the device leg is a real measurement, not a zero-fill
+        assert r.stages["device_us"] > 0
+
+
+def test_pump_serve_results_carry_stage_decomposition(graph_cache):
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    sess = ServeSession(graph_cache(2),
+                        policy=BatchPolicy(max_batch=2))
+    pump = sess.async_pump(window=2)
+    for s in (6, 5229, 8200, 999999):
+        sess.submit("sssp", {"source": s})
+    results = pump.drain()
+    assert all(r.ok for r in results)
+    for r in results:
+        assert set(r.stages) == _stage_keys(), r.stages
+        assert r.stages["device_us"] > 0
+        assert r.stages["dispatch_us"] > 0
+
+
+def test_serve_query_span_carries_tenant_and_queue_wait(graph_cache):
+    tr = obs.configure(in_memory=True)
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    sess = ServeSession(graph_cache(2),
+                        policy=BatchPolicy(max_batch=2))
+    sess.serve([("sssp", {"source": 6})])
+    spans = [e for e in tr.events()
+             if e["ph"] == "X" and e["name"] == "serve_query"]
+    assert spans
+    args = spans[0]["args"]
+    assert "tenant" in args and "queue_wait_us" in args
+    assert args["queue_wait_us"] >= 0
+
+
+def test_fused_hlo_identical_with_full_telemetry_armed(tmp_path):
+    """PR 5's pin, extended to the whole telemetry plane: arming the
+    tracer AND the SLOs AND the live exporter AND a postmortem sink is
+    a host-side decision — the fused runner's lowered HLO must stay
+    byte-identical, because every stage stamp is perf_counter_ns on
+    the host, invisible to jit."""
+    import jax
+
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.obs import exporter
+    from libgrape_lite_tpu.worker.worker import Worker
+    from tests.test_obs import _chain_fragment
+
+    frag = _chain_fragment(n=8, fnum=2)
+
+    def lowered_text():
+        w = Worker(SSSP(), frag)
+        state = w._place_state(w.app.init_state(frag, source=0))
+        eph = frozenset(getattr(w.app, "ephemeral_keys", ()) or ())
+        carry = {k: v for k, v in state.items() if k not in eph}
+        eph_part = {k: v for k, v in state.items() if k in eph}
+        runner = w._make_runner(0)(state)
+        return jax.jit(runner).lower(frag.dev, carry, eph_part).as_text()
+
+    disarmed = lowered_text()
+    obs.configure(in_memory=True)
+    slo.configure("sssp=5,*=100")
+    RECORDER.set_sink(str(tmp_path))
+    exp = exporter.start_exporter(0)
+    try:
+        armed = lowered_text()
+    finally:
+        exporter.stop_exporter()
+    assert exp is not None
+    assert disarmed == armed
+
+
+# ---- postmortem CLI --------------------------------------------------------
+
+
+def _dump_bundle_with_trace(tmp_path, graph_cache):
+    """A real armed serve run + a recorder dump, flushed to disk."""
+    trace = str(tmp_path / "trace.json")
+    obs.configure(trace_path=trace)
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    sess = ServeSession(graph_cache(2),
+                        policy=BatchPolicy(max_batch=2))
+    sess.serve([("sssp", {"source": s}) for s in (6, 5229)])
+    rec = FlightRecorder()
+    rec.set_sink(str(tmp_path))
+    path = rec.trigger("guard_breach",
+                       guard={"verdict": {"kind": "invariant"}})
+    obs.flush()
+    return path, trace
+
+
+def test_postmortem_cli_renders_and_byte_matches_trace(
+        tmp_path, capsys, graph_cache):
+    from libgrape_lite_tpu.cli import postmortem_main
+
+    bundle, trace = _dump_bundle_with_trace(tmp_path, graph_cache)
+    assert postmortem_main([bundle]) == 0
+    out = capsys.readouterr().out
+    assert "postmortem: guard_breach" in out
+    assert "guard:       yes (invariant)" in out
+    assert postmortem_main([bundle, "--trace", trace]) == 0
+    out = capsys.readouterr().out
+    assert "2 serve_query row(s) byte-matched, 0 mismatched, " \
+        "0 absent" in out
+
+
+def test_postmortem_cli_detects_row_drift(tmp_path, capsys,
+                                          graph_cache):
+    from libgrape_lite_tpu.cli import postmortem_main
+
+    bundle, trace = _dump_bundle_with_trace(tmp_path, graph_cache)
+    doc = json.load(open(bundle))
+    for s in doc["spans"]:
+        if s["name"] == "serve_query":
+            s["dur"] += 1  # any byte of drift must be caught
+    drifted = str(tmp_path / "drifted.json")
+    json.dump(doc, open(drifted, "w"))
+    assert postmortem_main([drifted, "--trace", trace]) == 1
+    assert "2 mismatched" in capsys.readouterr().out
+
+
+def test_postmortem_cli_rejects_foreign_schema(tmp_path, capsys):
+    from libgrape_lite_tpu.cli import postmortem_main
+
+    p = str(tmp_path / "not_a_bundle.json")
+    json.dump({"schema": "something-else-v9"}, open(p, "w"))
+    assert postmortem_main([p]) == 2
+    assert postmortem_main([str(tmp_path / "missing.json")]) == 2
+
+
+# ---- bench_compare ---------------------------------------------------------
+
+
+def _bench_compare():
+    sys.path.insert(0, "scripts")
+    try:
+        import bench_compare
+    finally:
+        sys.path.pop(0)
+    return bench_compare
+
+
+def test_bench_compare_directions_and_config_guard():
+    bc = _bench_compare()
+    assert bc._direction("qps") == +1
+    assert bc._direction("p99") == -1
+    assert bc._direction("wall_s") == -1
+    assert bc._direction("overhead_pct") == -1
+    assert bc._direction("scale") == 0       # config, never gated
+    assert bc._direction("engaged") == 0     # unknown leaf: ungated
+    base = {"metric": "m", "wall_s": 1.0}
+    rows, skipped = [], []
+    # identical config: the numeric leaf is compared
+    assert bc._walk(base, {"metric": "m", "wall_s": 2.0}, "x.",
+                    rows, skipped)
+    assert rows[0]["regress_pct"] == pytest.approx(100.0)
+    # config mismatch: the whole subtree is skipped, nothing gated
+    rows2, skipped2 = [], []
+    assert not bc._walk(base, {"metric": "OTHER", "wall_s": 9.0},
+                        "x.", rows2, skipped2)
+    assert rows2 == [] and skipped2
+
+
+def test_bench_compare_self_is_clean_and_seeded_regression_gates(
+        tmp_path):
+    bc = _bench_compare()
+    rec = {
+        "metric": "pagerank_rmat20_mteps_per_chip", "value": 100.0,
+        "unit": "MTEPS/chip", "vs_baseline": 0.03, "load_avg_1m": 0.5,
+        "telemetry": {
+            "namespaces": 8, "federation_ok": True, "stages": {
+                "device_us": {"p50": 100.0, "p99": 200.0},
+            }, "slo_observed": 16, "slo_breaches": 0,
+            "slo_max_burn": 0.0, "recorder_recorded": 3,
+            "recorder_dropped": 0, "recorder_triggers": 0,
+        },
+    }
+    base = str(tmp_path / "base.json")
+    json.dump(rec, open(base, "w"))
+    assert bc.main([base, base]) == 0
+    worse = dict(rec, value=40.0)
+    worse["telemetry"] = json.loads(json.dumps(rec["telemetry"]))
+    worse["telemetry"]["stages"]["device_us"]["p99"] = 2000.0
+    cand = str(tmp_path / "cand.json")
+    json.dump(worse, open(cand, "w"))
+    assert bc.main([base, cand]) == 2
+    # malformed candidate fails loudly (schema), not as a diff
+    bad = str(tmp_path / "bad.json")
+    json.dump(dict(rec, typo_field=1), open(bad, "w"))
+    assert bc.main([base, bad]) == 1
+
+
+def test_bench_schema_telemetry_block_validates():
+    sys.path.insert(0, "scripts")
+    try:
+        from check_bench_schema import self_check, validate_record
+    finally:
+        sys.path.pop(0)
+    assert self_check() == []
+    rec = {
+        "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 0.0,
+        "telemetry": {
+            "namespaces": 8, "federation_ok": True, "scrape_ok": True,
+            "stages": {"device_us": {"p50": 1.0, "p99": 2.0}},
+            "slo_observed": 16, "slo_breaches": 1, "slo_max_burn": 6.2,
+            "recorder_recorded": 3, "recorder_dropped": 0,
+            "recorder_triggers": 1,
+        },
+    }
+    assert validate_record(rec) == []
+    bad = json.loads(json.dumps(rec))
+    bad["telemetry"]["stages"]["device_us"]["p75"] = 1.5
+    assert any("p75" in e for e in validate_record(bad))
+    bad2 = json.loads(json.dumps(rec))
+    bad2["telemetry"]["federation_ok"] = 1  # int is not bool here
+    assert any("federation_ok" in e for e in validate_record(bad2))
