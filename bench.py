@@ -81,6 +81,22 @@ artifacts); any verdict failure exits 2.  Env knobs:
 GRAPE_BENCH_NO_FLEET=1 skips, GRAPE_BENCH_FLEET_QUERIES / _UPDATES
 size the lane (scale follows GRAPE_BENCH_SERVE_SCALE).
 
+BENCH-json autopilot fields (r16): `autopilot` carries the
+closed-loop drill (autopilot/, docs/AUTOPILOT.md) — the feeder's
+arrival rate is calibrated to 0.8x the measured service rate and
+DOUBLED a third of the way in (`rate_spec`, serve/feeder.py step
+schedule); the Autoscaler must answer with >= 1 scale-up through the
+zero-drop drain/rejoin/replicate machinery (`scale_ups`, `dropped`
+must be 0, `byte_identical` vs the static R=1 scripted run, `p99_ok`
+under GRAPE_BENCH_AUTOPILOT_P99_MS), and the result-cache sub-drill
+pins a repeated source answered with ZERO XLA compiles
+(`cache_hit_compiles`), a fence-bumping ingest reaping the epoch
+(`cache_invalidations` > 0), and the post-ingest answer
+byte-identical to a cache-less run on the same mutated graph
+(`post_ingest_identical`); any verdict failure exits 2.  Env knobs:
+GRAPE_BENCH_NO_AUTOPILOT=1 skips, GRAPE_BENCH_AUTOPILOT_QUERIES /
+_P99_MS size the lane (scale follows GRAPE_BENCH_SERVE_SCALE).
+
 BENCH-json telemetry fields (r15): `telemetry` carries the
 observability plane's own lane (obs/, docs/OBSERVABILITY.md) — the
 stats-federation census (`namespaces` registered + the
@@ -1663,6 +1679,277 @@ def main():
                 file=sys.stderr,
             )
 
+    # autopilot lane (r16, ROADMAP item 2): the closed-loop drill —
+    # one replica serving an sssp stream whose arrival rate (real
+    # wall-clock feeder) is calibrated to 0.8x the measured service
+    # rate and DOUBLED a third of the way in; the Autoscaler must
+    # answer with >= 1 scale-up through the zero-drop machinery, with
+    # zero dropped queries and per-query byte identity vs the static
+    # R=1 scripted run.  Then the result-cache sub-drill: a repeated
+    # source must hit with ZERO XLA compiles, one fence-bumping
+    # ingest must reap the cached epoch, and the post-ingest answer
+    # must byte-match a cache-less session on the same mutated graph.
+    # All five verdicts gate exit-2.  GRAPE_BENCH_NO_AUTOPILOT=1
+    # skips; GRAPE_BENCH_AUTOPILOT_QUERIES / _P99_MS size the lane.
+    autopilot_mismatch = None
+    if not os.environ.get("GRAPE_BENCH_NO_AUTOPILOT"):
+        try:
+            from collections import deque as _deque
+
+            from libgrape_lite_tpu.analysis import compile_events
+            from libgrape_lite_tpu.autopilot import (
+                Autoscaler,
+                ResultCache,
+                ScalerConfig,
+            )
+            from libgrape_lite_tpu.autopilot.signals import (
+                AUTOPILOT_STATS,
+            )
+            from libgrape_lite_tpu.dyn import RepackPolicy
+            from libgrape_lite_tpu.fleet import FleetRouter
+            from libgrape_lite_tpu.serve import (
+                ArrivalFeeder,
+                BatchPolicy,
+                ServeSession,
+            )
+
+            sys.path.insert(
+                0, os.path.join(os.path.dirname(os.path.abspath(
+                    __file__)), "scripts"))
+            from gen_rmat import delta_edges
+
+            ap_scale = int(os.environ.get(
+                "GRAPE_BENCH_SERVE_SCALE", min(SCALE, 12)))
+            ap_q = int(os.environ.get(
+                "GRAPE_BENCH_AUTOPILOT_QUERIES", 48))
+            ap_p99_bound = float(os.environ.get(
+                "GRAPE_BENCH_AUTOPILOT_P99_MS", 15000.0))
+            an_, a_src, a_dst, a_comm, a_vm = build_bench_inputs(
+                ap_scale)
+            rng_a = np.random.default_rng(11)
+            ap_srcs = [
+                int(x) for x in rng_a.integers(0, an_, size=ap_q)
+            ]
+
+            def ap_fragment():
+                return build_bench_weighted_fragment(
+                    a_src, a_dst, a_comm, a_vm, retain_edge_list=True
+                )
+
+            def ap_session(f):
+                return ServeSession(
+                    f, policy=BatchPolicy(max_batch=8),
+                    dyn=RepackPolicy(capacity=4096),
+                )
+
+            # static reference (R=1, scripted): the identity digests
+            # AND the service rate the feeder calibrates from
+            ref = ap_session(ap_fragment())
+            for s in ap_srcs[:4]:
+                ref.submit("sssp", {"source": s})
+            ref.drain()
+            t0 = time.perf_counter()
+            ref_reqs = [
+                ref.submit("sssp", {"source": s}) for s in ap_srcs
+            ]
+            ref.drain()
+            ref_wall = time.perf_counter() - t0
+            ref_digs = [
+                q.result.values.tobytes()
+                if q.result is not None and q.result.ok else b""
+                for q in ref_reqs
+            ]
+            svc_qps = ap_q / max(ref_wall, 1e-6)
+
+            # the load shift: 0.8x service rate, doubled at a third
+            # of the stream — the queue MUST grow from there, so the
+            # scale-up is deterministic, not a timing accident
+            step_at = max(2, ap_q // 3)
+            rate_spec = (
+                f"{max(1.0, round(0.8 * svc_qps, 1))}:2x@{step_at}"
+            )
+            AUTOPILOT_STATS.reset()
+            router = FleetRouter([ap_session(ap_fragment())])
+            ap_cache = ResultCache(capacity=1024)
+            router.attach_cache(ap_cache)
+
+            def ap_factory(f):
+                # a scale-up replica joins WARM (one throwaway query
+                # compiles its runners before it becomes routable)
+                s = ap_session(f)
+                s.submit("sssp", {"source": ap_srcs[0]})
+                s.drain()
+                return s
+
+            pilot = Autoscaler(
+                router,
+                ScalerConfig(min_replicas=1, max_replicas=2,
+                             window=2, cooldown_ticks=2,
+                             up_queue_depth=4),
+                session_factory=ap_factory,
+            )
+            for s in ap_srcs[:4]:  # warm r0 before the clock starts
+                router.submit("sssp", {"source": s})
+            router.drain()
+            inbox = _deque()
+            feeder = ArrivalFeeder(
+                lambda app, args, **kw: inbox.append((app, args)),
+                [("sssp", {"source": s}) for s in ap_srcs],
+                rate_spec,
+            )
+            ap_reqs = []
+            feeder.start()
+            while feeder.is_alive() or inbox or any(
+                r.session.queue.pending() or r.pump.inflight()
+                for r in router.replicas
+            ):
+                while inbox:
+                    app_key, args = inbox.popleft()
+                    ap_reqs.append(router.submit(app_key, dict(args)))
+                router.pump()
+                pilot.tick()
+            feeder.join()
+            router.drain()
+            ap_digs = [
+                q.result.values.tobytes()
+                if q.result is not None and q.result.ok else b""
+                for q in ap_reqs
+            ]
+            ap_drop = sum(1 for q in ap_reqs if q.result is None)
+            identical = ap_digs == ref_digs
+            from libgrape_lite_tpu.serve.queue import (
+                latency_summary_ms,
+            )
+
+            ap_lat = latency_summary_ms([
+                q.result.latency_s for q in ap_reqs
+                if q.result is not None
+            ])
+            p99_ok = ap_lat["p99_ms"] <= ap_p99_bound
+
+            # cache sub-drill: repeat of an answered source = a hit
+            # with ZERO compiles
+            hit_src = ap_srcs[0]
+            router.submit("sssp", {"source": hit_src})
+            router.drain()
+            hits0 = ap_cache.hits
+            with compile_events() as ev:
+                router.submit("sssp", {"source": hit_src})
+                router.drain()
+            cache_hit_compiles = ev.compiles
+            hit_seen = ap_cache.hits > hits0
+            # fence invalidation: one barrier ingest bumps the fence
+            # and reaps the epoch; the post-ingest answer must match
+            # a CACHE-LESS session on the same mutated graph
+            u2s, u2d = delta_edges(ap_scale, 32, seed=51)
+            rng_w2 = np.random.default_rng(53)
+            ap_ops = [
+                ("a", int(s), int(d), float(x)) for s, d, x in
+                zip(u2s, u2d, rng_w2.uniform(0.1, 10.0, 32))
+            ]
+            inv0 = ap_cache.invalidations
+            router.ingest(ap_ops)
+            invalidated = ap_cache.invalidations - inv0
+            post_req = router.submit("sssp", {"source": hit_src})
+            router.drain()
+            cold = ap_session(ap_fragment())
+            cold.ingest(ap_ops)
+            cold_req = cold.submit("sssp", {"source": hit_src})
+            cold.drain()
+            post_identical = bool(
+                post_req.result is not None and post_req.result.ok
+                and cold_req.result is not None
+                and cold_req.result.ok
+                and post_req.result.values.tobytes()
+                == cold_req.result.values.tobytes()
+            )
+
+            ap_stats = AUTOPILOT_STATS.snapshot()
+            autopilot_block = {
+                "scale": ap_scale,
+                "queries": ap_q,
+                "ok": sum(
+                    1 for q in ap_reqs
+                    if q.result is not None and q.result.ok
+                ),
+                "dropped": ap_drop,
+                "rate_spec": rate_spec,
+                "min_replicas": 1,
+                "max_replicas": 2,
+                "replicas_final": sum(
+                    1 for r in router.replicas if r.routable
+                ),
+                "scale_ups": ap_stats["scale_ups"],
+                "scale_downs": ap_stats["scale_downs"],
+                "ticks": ap_stats["ticks"],
+                "p99_ms": ap_lat["p99_ms"],
+                "p99_bound_ms": ap_p99_bound,
+                "p99_ok": p99_ok,
+                "byte_identical": identical,
+                "cache_hits": ap_cache.hits,
+                "cache_misses": ap_cache.misses,
+                "cache_hit_compiles": cache_hit_compiles,
+                "cache_invalidations": ap_cache.invalidations,
+                "post_ingest_identical": post_identical,
+            }
+            record["autopilot"] = autopilot_block
+            _emit_record(record)
+            print(
+                f"[bench] autopilot: rate={rate_spec} "
+                f"scale_ups={ap_stats['scale_ups']} "
+                f"replicas={autopilot_block['replicas_final']} "
+                f"identical={identical} dropped={ap_drop} "
+                f"p99={ap_lat['p99_ms']}ms "
+                f"cache_hits={ap_cache.hits} "
+                f"hit_compiles={cache_hit_compiles} "
+                f"invalidated={invalidated} "
+                f"post_ingest_identical={post_identical}",
+                file=sys.stderr,
+            )
+            if not identical:
+                autopilot_mismatch = (
+                    "autoscaled results diverged from the static R=1 "
+                    "run — scaling changed answers"
+                )
+            elif ap_drop:
+                autopilot_mismatch = (
+                    f"{ap_drop} dropped quer(ies) — the scale moves "
+                    "were not zero-drop"
+                )
+            elif ap_stats["scale_ups"] < 1:
+                autopilot_mismatch = (
+                    "no scale-up under a 2x mid-stream rate step — "
+                    "the control loop never closed"
+                )
+            elif not p99_ok:
+                autopilot_mismatch = (
+                    f"p99 {ap_lat['p99_ms']}ms over the "
+                    f"{ap_p99_bound}ms bound"
+                )
+            elif cache_hit_compiles or not hit_seen:
+                autopilot_mismatch = (
+                    f"repeated-source hit compiled "
+                    f"{cache_hit_compiles} time(s) (hit_seen="
+                    f"{hit_seen}) — the cache did not skip the device"
+                )
+            elif not invalidated:
+                autopilot_mismatch = (
+                    "the fence-bumping ingest invalidated nothing — "
+                    "stale epoch entries survived"
+                )
+            elif not post_identical:
+                autopilot_mismatch = (
+                    "post-ingest answer diverged from a cache-less "
+                    "run on the mutated graph — the cache served a "
+                    "stale epoch"
+                )
+        except Exception as e:  # the lane must not cost the bench
+            print(
+                f"[bench] autopilot lane failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+
     # superstep-pipelining lane (r9, ROADMAP item 3): serial vs
     # pipelined wall at fnum>=2 with the byte-identity verdict, the
     # modeled hidden-exchange fraction, the boundary-set sizes and the
@@ -1971,6 +2258,13 @@ def main():
         print(
             f"[bench] FATAL: fleet lane verdict failed: "
             f"{fleet_mismatch} — see the fleet block above",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    if autopilot_mismatch is not None:
+        print(
+            f"[bench] FATAL: autopilot lane verdict failed: "
+            f"{autopilot_mismatch} — see the autopilot block above",
             file=sys.stderr,
         )
         sys.exit(2)

@@ -1,4 +1,4 @@
-"""Layer 1 of grape-lint: AST checks R1-R8 over the library source.
+"""Layer 1 of grape-lint: AST checks R1-R9 over the library source.
 
 Each checker's docstring names the historical, actually-shipped bug it
 fossilizes (see analysis/rules.py for the catalogue and CHANGES.md for
@@ -1063,12 +1063,97 @@ def _check_r8(module: _Scope, path: str,
 
 
 # ---------------------------------------------------------------------------
+# R9 cache-key-completeness
+# ---------------------------------------------------------------------------
+
+#: the result-cache identity contract (autopilot/cache.py
+#: CACHE_KEY_FIELDS) with the synonyms a call site may spell each
+#: field with — "fence" is the router's graph-version fence, which
+#: bare sessions carry as an ingest epoch and replicas as a version
+_R9_KEY_FIELDS = (
+    ("compat", ("compat",)),
+    ("source", ("source",)),
+    ("fence", ("fence", "epoch", "version")),
+)
+_R9_CACHE_METHODS = {"lookup", "store"}
+
+
+def _r9_idents(node: ast.AST) -> Set[str]:
+    """Every identifier-ish token an argument expression names: Name
+    ids, Attribute attrs, and string constants — the surface a key
+    field could be spelled on."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
+
+
+def _check_r9(module: _Scope, path: str,
+              findings: List[Finding]) -> None:
+    """R9 cache-key-completeness.  A `.lookup(...)`/`.store(...)`
+    call whose receiver chain names a cache (``self._cache``,
+    ``queue.result_cache``, a bare ``cache``) is a result-cache call
+    site; its arguments must name EVERY field of the result identity
+    — the compat key, the lane source, and the fence epoch
+    (autopilot/cache.py CACHE_KEY_FIELDS) — or two structurally
+    different queries / two graph versions could share one cached
+    answer.  autopilot/cache.py itself is exempt (it IS the keyed
+    surface; its internals take the fields apart)."""
+    if path.endswith("autopilot/cache.py"):
+        return
+    for n in ast.walk(module.node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in _R9_CACHE_METHODS):
+            continue
+        chain = []
+        v = f.value
+        while isinstance(v, ast.Attribute):
+            chain.append(v.attr)
+            v = v.value
+        if isinstance(v, ast.Name):
+            chain.append(v.id)
+        if not any("cache" in part.lower() for part in chain):
+            continue
+        idents = set()
+        for a in n.args:
+            idents |= _r9_idents(a)
+        for kw in n.keywords:
+            if kw.arg:
+                idents.add(kw.arg)
+            idents |= _r9_idents(kw.value)
+        lowered = {i.lower() for i in idents}
+        missing = [
+            field for field, synonyms in _R9_KEY_FIELDS
+            if not any(s in tok for s in synonyms for tok in lowered)
+        ]
+        if missing:
+            findings.append(Finding(
+                "R9", path, n.lineno, f.attr,
+                f"result-cache {f.attr}() does not name the full "
+                f"result identity — missing {', '.join(missing)}: "
+                "every lookup/store must carry every compat_key "
+                "field plus the lane source and the fence epoch "
+                "(autopilot/cache.py CACHE_KEY_FIELDS), or a stale "
+                "or structurally different answer can be served as "
+                "a hit",
+            ))
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
 
 def lint_source(src: str, relpath: str) -> List[Finding]:
-    """All R1-R8 findings for one module's source text."""
+    """All R1-R9 findings for one module's source text."""
     relpath = relpath.replace(os.sep, "/")
     try:
         tree = ast.parse(src)
@@ -1093,6 +1178,7 @@ def lint_source(src: str, relpath: str) -> List[Finding]:
     _check_r6(module, relpath, findings)
     _check_r7(module, relpath, findings)
     _check_r8(module, relpath, findings)
+    _check_r9(module, relpath, findings)
     return findings
 
 

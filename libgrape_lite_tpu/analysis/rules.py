@@ -127,6 +127,22 @@ RULES: Dict[str, Rule] = {
             "drifted the same way (zero-entry baseline)",
         ),
         Rule(
+            "R9", "cache-key-completeness",
+            "a call into the autopilot result cache "
+            "(autopilot/cache.py lookup()/store()) does not name "
+            "every field of the result identity — the compat key, "
+            "the lane source, and the fence epoch "
+            "(cache.CACHE_KEY_FIELDS) — so two structurally "
+            "different queries (or two graph versions) could share "
+            "one cached answer",
+            "PR 16 (preventive): the result cache is sound only "
+            "because its key carries the FULL compat_key plus the "
+            "router fence; the R3 incident (a cache key missing "
+            "max_rounds silently shared one compile) shows exactly "
+            "how a dropped key field ships — fossilized here for the "
+            "result cache before it can recur (zero-entry baseline)",
+        ),
+        Rule(
             "A1", "constant-bloat",
             "the lowered HLO of a fused runner holds a literal "
             "constant above the byte threshold — an R1 escape "

@@ -1,0 +1,61 @@
+"""autopilot/ — the self-operating fleet control plane (ROADMAP 2).
+
+Closes the observe -> decide -> act loop the telemetry plane (PR 15)
+and the fleet machinery (PR 13) left open:
+
+  * `signals`   — SignalReader/ControlSignals: one typed snapshot of
+    burn, queue depth/waits, and replica load, with the hysteresis
+    window; the federated AUTOPILOT_STATS namespace.
+  * `scaler`    — Autoscaler: pure `decide` over the window, `act`
+    strictly through drain/rejoin/replicate under the HBM budget.
+  * `admission` — AdmissionController: ledger-priced per-query cost,
+    shed (`reason=shed_over_budget`) or defer tenants past their
+    error budget.
+  * `cache`     — ResultCache: fence-epoch result cache for point
+    queries; a hit skips the device and still hits the SLO/trace
+    surfaces.
+
+docs/AUTOPILOT.md is the user guide; the CLI surface is
+`serve --autopilot [--min_replicas N --max_replicas M
+--cache_entries K]`.
+"""
+
+from libgrape_lite_tpu.autopilot.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    decide_admission,
+    query_cost,
+)
+from libgrape_lite_tpu.autopilot.cache import (
+    CACHE_KEY_FIELDS,
+    ResultCache,
+)
+from libgrape_lite_tpu.autopilot.scaler import (
+    Autoscaler,
+    Decision,
+    ScalerConfig,
+    decide,
+)
+from libgrape_lite_tpu.autopilot.signals import (
+    AUTOPILOT_STATS,
+    ControlSignals,
+    SignalReader,
+    record_decision,
+)
+
+__all__ = [
+    "AUTOPILOT_STATS",
+    "AdmissionConfig",
+    "AdmissionController",
+    "Autoscaler",
+    "CACHE_KEY_FIELDS",
+    "ControlSignals",
+    "Decision",
+    "ResultCache",
+    "ScalerConfig",
+    "SignalReader",
+    "decide",
+    "decide_admission",
+    "query_cost",
+    "record_decision",
+]

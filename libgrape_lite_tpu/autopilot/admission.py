@@ -1,0 +1,170 @@
+"""Priced per-query admission: shed or defer tenants past budget.
+
+`fleet/budget.py` already prices SESSIONS from the pack ledgers
+(SparseP discipline: price from a cost model, never hand-tune a
+watermark).  This module extends the same ledger geometry to
+INDIVIDUAL queries: one round of a point query costs what the
+fragment's resolved pack plan says it moves/computes
+(`spmv_pack.plan_ledger` totals), scaled by the round limit — so the
+admission controller knows what a request will cost BEFORE the fleet
+pays for it.
+
+The decide step is a pure function over (tenant burn, priced cost):
+
+  * burn below `defer_burn`      -> admit;
+  * past budget but under
+    `shed_burn` (and affordable) -> **defer**: the request stays
+    queued, but `AdmissionQueue._head_batch` serves in-budget tenants
+    first — deferred work re-queues BEHIND them, never starves
+    (an all-deferred queue still drains);
+  * at/over `shed_burn`, or an
+    over-budget tenant's request
+    pricier than `max_cost`      -> **shed**: a loud failed
+    ServeResult with ``reason=shed_over_budget``, counted and
+    returned through `take_expired` exactly like `deadline_expired`
+    — and it burns the tenant's error budget via `slo.observe`, like
+    every other failure (the PR's queue.py bugfix).
+
+Every decision is recorded in the federated ``autopilot`` namespace
+(signals.record_decision), never silent.  docs/AUTOPILOT.md covers
+the pricing model and tuning.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from libgrape_lite_tpu.autopilot.signals import (
+    AUTOPILOT_STATS,
+    record_decision,
+)
+
+#: rounds assumed for an unbounded request (max_rounds=None) — the
+#: pricing must stay finite; callers with a real limit are priced
+#: exactly
+DEFAULT_PRICED_ROUNDS = 16
+
+
+def query_cost(fragment, max_rounds: Optional[int] = None) -> float:
+    """Estimated cost of one point query on `fragment`, in
+    HBM-bytes-per-query: the resolved pack plans' per-round ledger
+    bytes (`spmv_pack.plan_ledger` — the SAME totals the HBM budget
+    prices sessions from) times the round limit.  Falls back to the
+    fragment's CSR byte size per round when no plan has been resolved
+    yet (a fresh session priced before its first query)."""
+    rounds = int(max_rounds) if max_rounds else DEFAULT_PRICED_ROUNDS
+    per_round = 0.0
+    try:
+        from libgrape_lite_tpu.ops.spmv_pack import (
+            _frag_cache,
+            plan_ledger,
+        )
+
+        for plan in _frag_cache(fragment).values():
+            try:
+                totals = plan_ledger(plan)["totals"]
+                per_round = max(
+                    per_round, float(totals.get("hbm_bytes", 0))
+                )
+            except Exception:
+                continue
+    except Exception:
+        per_round = 0.0
+    if per_round <= 0.0:
+        from libgrape_lite_tpu.fleet.budget import fragment_bytes
+
+        per_round = float(fragment_bytes(fragment))
+    AUTOPILOT_STATS["priced"] += 1
+    return per_round * rounds
+
+
+@dataclass(frozen=True)
+class AdmissionConfig:
+    """Thresholds of the shed/defer policy (docs/AUTOPILOT.md)."""
+
+    # burn >= 1.0 means the error budget is spent; defer starts there
+    defer_burn: float = 1.0
+    # a tenant burning at 2x budget no longer gets device time at all
+    shed_burn: float = 2.0
+    # optional absolute cost ceiling (HBM bytes/query): an OVER-BUDGET
+    # tenant's request pricier than this sheds instead of deferring —
+    # in-budget tenants are never cost-gated (None disables)
+    max_cost: Optional[float] = None
+
+    def __post_init__(self):
+        if self.defer_burn <= 0:
+            raise ValueError(
+                f"defer_burn must be > 0, got {self.defer_burn}"
+            )
+        if self.shed_burn < self.defer_burn:
+            raise ValueError(
+                f"shed_burn ({self.shed_burn}) must be >= defer_burn "
+                f"({self.defer_burn})"
+            )
+
+
+def decide_admission(burn: float, cost: float,
+                     cfg: AdmissionConfig) -> str:
+    """Pure decide: 'admit' | 'defer' | 'shed' for one request of a
+    tenant burning `burn` with priced cost `cost`."""
+    if burn < cfg.defer_burn:
+        return "admit"
+    if burn >= cfg.shed_burn:
+        return "shed"
+    if cfg.max_cost is not None and cost > cfg.max_cost:
+        return "shed"
+    return "defer"
+
+
+class AdmissionController:
+    """The queue-side hook: `review(req)` prices one pending request,
+    reads its tenant's burn from the SLO surface, and returns the
+    decide verdict.  Wire with ``queue.admission = ctl.review`` (the
+    ServeSession/FleetRouter attach helpers do this) — the queue's
+    `_pop_ready` sweep then sheds/defers before coalescing.
+
+    `cost_of` defaults to `query_cost` over `fragment`; pass a
+    callable for tests (pure decide tables need no fragment)."""
+
+    def __init__(self, config: Optional[AdmissionConfig] = None,
+                 fragment=None,
+                 cost_of: Optional[Callable] = None):
+        self.config = config or AdmissionConfig()
+        self._fragment = fragment
+        self._cost_of = cost_of
+
+    def burn_of(self, tenant: Optional[str]) -> float:
+        """Current burn of one tenant's objective key (0.0 when the
+        tenant has no objective or nothing was observed yet)."""
+        from libgrape_lite_tpu.obs.slo import SLO_STATS
+
+        if tenant is None:
+            return 0.0
+        burn = SLO_STATS.get("burn_by_key") or {}
+        return float(burn.get(f"tenant:{tenant}", 0.0))
+
+    def cost_of(self, req) -> float:
+        if self._cost_of is not None:
+            return float(self._cost_of(req))
+        if self._fragment is None:
+            return 0.0
+        return query_cost(self._fragment, req.max_rounds)
+
+    def review(self, req) -> str:
+        """'admit' | 'defer' | 'shed' for one queued request.  Records
+        shed/defer decisions (admits are the steady state and only
+        counted implicitly); never raises — an admission failure must
+        not wedge the queue head."""
+        try:
+            burn = self.burn_of(req.tenant)
+            cost = self.cost_of(req)
+            verdict = decide_admission(burn, cost, self.config)
+        except Exception:
+            return "admit"
+        if verdict != "admit":
+            record_decision(
+                verdict, tenant=req.tenant or "", app=req.app_key,
+                burn=round(burn, 4), cost=round(cost, 1),
+            )
+        return verdict

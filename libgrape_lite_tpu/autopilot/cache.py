@@ -1,0 +1,157 @@
+"""Fence-epoch result cache: point queries that skip the device.
+
+Point queries (the `batch_query_key` contract — sssp/bfs/khop/ppr/cn
+sources) repeat heavily in user-shaped traffic, and a repeat of an
+already-answered (graph, query) pair needs NO device work at all —
+the cheapest qps multiplier available (ROADMAP item 2).
+
+Soundness rests on two existing contracts:
+
+  * the **key** carries every field of `policy.compat_key` (app, round
+    limit, guard policy, non-lane args, lane-arg presence, tenant) —
+    the same structural identity that gates batching; two requests
+    with equal compat keys would compile to the SAME runner, so equal
+    keys + equal source imply byte-identical answers.  grape-lint R9
+    (`cache-key-completeness`) pins every call site to this shape.
+  * the **epoch** is the fleet's graph-version fence
+    (fleet/router.py; a bare session's ingest counter stands in for
+    it).  Every ingest bumps the fence BEHIND a drain barrier, so an
+    entry stored at fence F was computed on graph version F, a lookup
+    at fence F' > F structurally misses, and `invalidate_stale(F')`
+    drops the dead epoch wholesale.
+
+A hit is not invisible: the serving layer still mints a ServeResult
+with stage stamps, emits a `serve_query` span with ``cached=true``,
+and runs `slo.observe` — SLOs and the trace see cached traffic like
+any other (serve/session.py `_deliver_cached`).
+
+Counters ride the federated ``autopilot`` namespace
+(signals.AUTOPILOT_STATS) next to per-instance hit/miss fields.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from libgrape_lite_tpu.autopilot.signals import AUTOPILOT_STATS
+
+#: the identity a cache key must carry — every `policy.compat_key`
+#: field plus the lane source and the fence epoch.  grape-lint R9
+#: (analysis/astlint.py) anchors on this contract: a lookup()/store()
+#: call site whose arguments do not name a compat key, a source, and
+#: a fence is flagged as an incomplete cache key.
+CACHE_KEY_FIELDS: Tuple[str, ...] = ("compat", "source", "fence")
+
+
+class ResultCache:
+    """Bounded LRU of (compat_key, source, fence) -> finished result.
+
+    `capacity` bounds entries (LRU eviction, counted).  Thread-safe:
+    the serving feeder thread may probe while the pump stores."""
+
+    def __init__(self, capacity: int = 1024):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._entries: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # ---- the keyed surface (grape-lint R9 audits every call site) ---------
+
+    @staticmethod
+    def _key(compat, source, fence):
+        return (compat, source, int(fence))
+
+    def lookup(self, compat, source, fence) -> Optional[tuple]:
+        """Return `(values, rounds, terminate_code)` for a finished
+        query of this exact identity at this fence, or None.  An
+        unhashable key (exotic arg values) is a miss, never a raise —
+        the cache must not become a failure mode of admission."""
+        try:
+            k = self._key(compat, source, fence)
+            with self._lock:
+                ent = self._entries.get(k)
+                if ent is not None:
+                    self._entries.move_to_end(k)
+        except TypeError:
+            ent = None
+        if ent is None:
+            self.misses += 1
+            AUTOPILOT_STATS["cache_misses"] += 1
+            return None
+        self.hits += 1
+        AUTOPILOT_STATS["cache_hits"] += 1
+        return ent
+
+    def store(self, compat, source, fence, result) -> bool:
+        """Store one OK result under its full identity.  `result` is a
+        ServeResult (values resolved lazily here — by store time the
+        harvest already synced them).  Returns False when the result
+        is not cacheable (failed, value-less, unhashable key)."""
+        if result is None or not result.ok:
+            return False
+        if getattr(result, "deferred", False):
+            # a lazy-harvest result (serve/pipeline.py
+            # eager_values=False) is not forced here — storing must
+            # never un-defer the very extraction the window hides
+            return False
+        try:
+            vals = result.values
+        except Exception:
+            return False
+        if vals is None:
+            return False
+        try:
+            k = self._key(compat, source, fence)
+            with self._lock:
+                self._entries[k] = (
+                    vals, result.rounds, result.terminate_code,
+                )
+                self._entries.move_to_end(k)
+                while len(self._entries) > self.capacity:
+                    self._entries.popitem(last=False)
+                    self.evictions += 1
+                    AUTOPILOT_STATS["cache_evictions"] += 1
+        except TypeError:
+            return False
+        self.stores += 1
+        AUTOPILOT_STATS["cache_stores"] += 1
+        return True
+
+    # ---- epoch invalidation -----------------------------------------------
+
+    def invalidate_stale(self, fence) -> int:
+        """Drop every entry whose epoch differs from `fence` — the
+        wholesale death of a stale epoch after an ingest bumped the
+        fence (fleet/router.py calls this at the end of `ingest`).
+        Returns the number of entries dropped (counted)."""
+        fence = int(fence)
+        with self._lock:
+            stale = [k for k in self._entries if k[2] != fence]
+            for k in stale:
+                del self._entries[k]
+        if stale:
+            self.invalidations += len(stale)
+            AUTOPILOT_STATS["cache_invalidations"] += len(stale)
+        return len(stale)
+
+    def snapshot(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "capacity": self.capacity,
+            "hits": self.hits,
+            "misses": self.misses,
+            "stores": self.stores,
+            "evictions": self.evictions,
+            "invalidations": self.invalidations,
+        }

@@ -1,0 +1,264 @@
+"""Autoscaler: burn/depth signals in, drain/rejoin/replicate out.
+
+The DECIDE leg is a pure function (`decide`) over the SignalReader's
+hysteresis window — testable without a fleet, like the admission
+decide.  The ACT leg (`Autoscaler.act`) only ever moves the fleet
+through the shipped zero-drop machinery:
+
+  * **scale up** — rejoin a previously-drained replica when one is
+    parked (its catch-up log replays to the fence — the cheap path:
+    every host artifact is still warm), else replicate a fresh
+    fragment from a live replica (`replicate_fragment`: deterministic
+    rebuild from the retained edge list, so the newcomer answers
+    byte-identically) and `FleetRouter.add_replica` it at the current
+    fence.  A pending dyn overlay is folded first (a counted forced
+    repack) so the retained edge list IS the current graph.
+  * **scale down** — `begin_drain` WITHOUT rejoin: the replica
+    finishes every admitted query (zero drops), stops routing, and
+    parks warm with a catch-up log — which is exactly what makes the
+    next scale-up cheap.  The last routable replica can never be
+    drained (fleet/drain.py guards it; decide holds at min_replicas
+    before it gets there).
+
+Guard rails: min/max replica bounds, a cooldown (ticks) after every
+act so the fleet settles before the next move, the HBM budget
+(fleet/budget.py — a scale-up that does not fit is a recorded hold,
+never an OOM), and the hysteresis window (one spike never flaps the
+fleet).  Every decision — scale_up / scale_down / hold, with its
+reason — is recorded in the federated ``autopilot`` namespace.
+
+docs/AUTOPILOT.md diagrams the loop and names the tuning knobs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Callable, Optional, Sequence
+
+from libgrape_lite_tpu.autopilot.signals import (
+    ControlSignals,
+    SignalReader,
+    record_decision,
+)
+
+
+@dataclass(frozen=True)
+class ScalerConfig:
+    """Knobs of the scaling policy (docs/AUTOPILOT.md "Tuning")."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # hysteresis: the up/down condition must hold across this many
+    # consecutive signal reads before the scaler acts
+    window: int = 3
+    # ticks to sit out after any act (the fleet needs a few pumps to
+    # absorb a topology change before the signals mean anything)
+    cooldown_ticks: int = 4
+    # scale-up pressure: queue depth PER ROUTABLE REPLICA above this
+    # is overload ...
+    up_queue_depth: int = 8
+    # ... or the p99 submit->dispatch wait above this (ms; 0 disables)
+    up_wait_p99_ms: float = 0.0
+    # ... or any tenant/app burning past this error-budget multiple
+    # (0 disables; burn >= 1.0 means the budget is spent)
+    up_burn: float = 0.0
+    # scale-down calm: total depth at/below this AND nothing burning
+    down_queue_depth: int = 0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})"
+            )
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.cooldown_ticks < 0:
+            raise ValueError(
+                f"cooldown_ticks must be >= 0, got {self.cooldown_ticks}"
+            )
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One decide() verdict: what to do, why, and the replica target."""
+
+    action: str      # "scale_up" | "scale_down" | "hold"
+    reason: str
+    replicas: int    # routable count the decision saw
+    target: int      # routable count the action aims for
+
+
+def _overloaded(sig: ControlSignals, cfg: ScalerConfig) -> bool:
+    per = sig.queue_depth / max(1, sig.replicas)
+    if per > cfg.up_queue_depth:
+        return True
+    if cfg.up_wait_p99_ms and sig.wait_p99_ms > cfg.up_wait_p99_ms:
+        return True
+    if cfg.up_burn and sig.max_burn >= cfg.up_burn:
+        return True
+    return False
+
+
+def _calm(sig: ControlSignals, cfg: ScalerConfig) -> bool:
+    if sig.queue_depth > cfg.down_queue_depth:
+        return False
+    if sig.outstanding > 0:
+        return False
+    if cfg.up_burn and sig.max_burn >= cfg.up_burn:
+        return False
+    return True
+
+
+def decide(window: Sequence[ControlSignals], cfg: ScalerConfig,
+           *, cooldown: int = 0) -> Decision:
+    """Pure policy: the hysteresis window in, one Decision out.
+
+    `window` is oldest-first (SignalReader.recent); `cooldown` is the
+    ticks left to sit out (an act younger than cooldown_ticks)."""
+    if not window:
+        return Decision("hold", "no_signals", 0, 0)
+    cur = window[-1]
+    n = cur.replicas
+    if cooldown > 0:
+        return Decision("hold", "cooldown", n, n)
+    if len(window) < cfg.window:
+        return Decision("hold", "window_filling", n, n)
+    recent = list(window)[-cfg.window:]
+    if all(_overloaded(s, cfg) for s in recent):
+        if n >= cfg.max_replicas:
+            return Decision("hold", "at_max_replicas", n, n)
+        per = cur.queue_depth / max(1, n)
+        if cfg.up_burn and cur.max_burn >= cfg.up_burn:
+            why = f"burn {cur.max_burn:.2f} >= {cfg.up_burn}"
+        elif per > cfg.up_queue_depth:
+            why = (f"queue depth {cur.queue_depth} over "
+                   f"{cfg.up_queue_depth}/replica x {n}")
+        else:
+            why = (f"wait p99 {cur.wait_p99_ms}ms > "
+                   f"{cfg.up_wait_p99_ms}ms")
+        return Decision("scale_up", why, n, n + 1)
+    if all(_calm(s, cfg) for s in recent):
+        if n <= cfg.min_replicas:
+            return Decision("hold", "at_min_replicas", n, n)
+        return Decision("scale_down", "sustained_idle", n, n - 1)
+    return Decision("hold", "in_band", n, n)
+
+
+class Autoscaler:
+    """Observe (SignalReader) -> decide (pure) -> act (fleet moves).
+
+    `session_factory(fragment)` builds a replica ServeSession around a
+    freshly replicated fragment — without it, scale-up can only rejoin
+    previously-drained replicas.  `budget` (FleetBudget) gates fresh
+    replicas under the HBM capacity."""
+
+    def __init__(self, router, config: Optional[ScalerConfig] = None,
+                 *, session_factory: Optional[Callable] = None,
+                 budget=None, reader: Optional[SignalReader] = None):
+        self.router = router
+        self.config = config or ScalerConfig()
+        self.reader = reader or SignalReader(
+            router, window=self.config.window
+        )
+        self._factory = session_factory
+        self.budget = budget
+        self.cooldown = 0
+
+    # ---- the loop ---------------------------------------------------------
+
+    def tick(self) -> Decision:
+        """One control iteration: read, decide, act, record.  The
+        serve loop calls this between pumps; it never raises (an act
+        that fails becomes a recorded hold)."""
+        from libgrape_lite_tpu.autopilot.signals import AUTOPILOT_STATS
+
+        AUTOPILOT_STATS["ticks"] += 1
+        self.reader.read()
+        d = decide(self.reader.recent, self.config,
+                   cooldown=self.cooldown)
+        if self.cooldown > 0:
+            self.cooldown -= 1
+        if d.action != "hold":
+            d = self.act(d)
+        record_decision(d.action, reason=d.reason,
+                        replicas=d.replicas, target=d.target,
+                        fence=self.router.fence)
+        return d
+
+    # ---- the actuators ----------------------------------------------------
+
+    def _routable(self):
+        return [r for r in self.router.replicas if r.routable]
+
+    def act(self, decision: Decision) -> Decision:
+        """Execute one non-hold decision through the zero-drop fleet
+        machinery.  Returns the decision actually taken (an act that
+        cannot proceed — budget, guards, a failed replicate — demotes
+        to a recorded hold)."""
+        try:
+            if decision.action == "scale_up":
+                return self._scale_up(decision)
+            if decision.action == "scale_down":
+                return self._scale_down(decision)
+        except Exception as e:  # the loop must outlive a failed act
+            return replace(
+                decision, action="hold",
+                reason=f"act_failed: {type(e).__name__}: {e}",
+            )
+        return decision
+
+    def _scale_up(self, decision: Decision) -> Decision:
+        parked = [r for r in self.router.replicas if not r.routable]
+        if parked:
+            idx = parked[0].idx
+            self.router.rejoin(idx)
+            self.cooldown = self.config.cooldown_ticks
+            return replace(
+                decision, reason=decision.reason + f"; rejoined r{idx}"
+            )
+        if self._factory is None:
+            return replace(decision, action="hold",
+                           reason="no_session_factory")
+        src = self._routable()[0].session
+        if self.budget is not None and self.budget.capacity:
+            from libgrape_lite_tpu.fleet.budget import session_footprint
+
+            est = session_footprint(src).total
+            if self.budget.used_bytes() + est > self.budget.capacity:
+                return replace(
+                    decision, action="hold",
+                    reason=f"hbm_budget: +{est}B over capacity",
+                )
+        if src.dyn is not None and src.dyn.overlay_count:
+            # fold the pending overlay so the retained edge list IS
+            # the current graph — a counted forced repack on the
+            # source, not a silent stale replica
+            src.ingest([], force_repack=True)
+        from libgrape_lite_tpu.fragment.mutation import (
+            replicate_fragment,
+        )
+
+        sess = self._factory(replicate_fragment(src.fragment))
+        r = self.router.add_replica(sess)
+        self.cooldown = self.config.cooldown_ticks
+        return replace(
+            decision, reason=decision.reason + f"; added r{r.idx}"
+        )
+
+    def _scale_down(self, decision: Decision) -> Decision:
+        routable = self._routable()
+        if len(routable) <= max(1, self.config.min_replicas):
+            return replace(decision, action="hold",
+                           reason="at_min_replicas")
+        victim = routable[-1]  # highest index: LIFO, deterministic
+        self.router.begin_drain(victim.idx)
+        self.cooldown = self.config.cooldown_ticks
+        return replace(
+            decision,
+            reason=decision.reason + f"; drained r{victim.idx}",
+        )

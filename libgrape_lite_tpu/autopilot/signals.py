@@ -1,0 +1,192 @@
+"""Control signals: the autopilot's one read of the telemetry plane.
+
+The autopilot (docs/AUTOPILOT.md) closes the observe->decide->act loop
+over the serving fleet, and this module is the OBSERVE leg: one
+`SignalReader.read()` snapshots the federation registry
+(obs/federation.py) plus the live queue/router objects into a typed,
+immutable `ControlSignals` view —
+
+  * per-tenant error-budget burn from the ``slo`` namespace
+    (obs/slo.py — breaches / (observed * budget_frac)),
+  * queue depth and p50/p99 submit->dispatch wait from the admission
+    queues (serve/queue.py records every popped request's wait),
+  * per-replica outstanding / routable count / fence from the
+    FleetRouter (fleet/router.py).
+
+The reader keeps a bounded WINDOW of recent snapshots (`window`), and
+the scaler's decide() demands a signal hold across the WHOLE window
+before acting — the hysteresis that keeps one spike from flapping the
+fleet up and down (docs/AUTOPILOT.md "Tuning").
+
+Every autopilot counter lives in the federated ``autopilot``
+namespace (`AUTOPILOT_STATS` — obs/federation.py EXPECTED), so the
+exporter, the flight recorder, and `federation.self_check()` see the
+control plane like any other subsystem.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from libgrape_lite_tpu.obs import federation as _federation
+from libgrape_lite_tpu.obs.federation import FederatedStats
+
+# importing the slo module registers the "slo" namespace, so a reader
+# constructed before any objective is configured still snapshots a
+# live (empty) surface instead of a missing one
+from libgrape_lite_tpu.obs import slo as _slo  # noqa: F401
+
+#: every decision the control plane takes, counted and bounded — the
+#: PUMP_STATS/FLEET_STATS recorded-decision discipline: an autopilot
+#: that silently flapped, shed, or refused to scale is visible in one
+#: dict instead of a wall-clock mystery
+AUTOPILOT_STATS = FederatedStats("autopilot", {
+    "ticks": 0,
+    "scale_ups": 0,
+    "scale_downs": 0,
+    "holds": 0,
+    "shed": 0,
+    "deferred": 0,
+    "priced": 0,
+    "cache_hits": 0,
+    "cache_misses": 0,
+    "cache_stores": 0,
+    "cache_evictions": 0,
+    "cache_invalidations": 0,
+    "decisions": [],
+})
+
+#: bound on the recorded decision list (the FleetStats.MAX_EVENTS
+#: discipline: long-lived processes must not grow without bound)
+MAX_DECISIONS = 256
+
+
+def record_decision(kind: str, **detail) -> None:
+    """Append one bounded decision event and bump its counter."""
+    counter = {
+        "scale_up": "scale_ups",
+        "scale_down": "scale_downs",
+        "hold": "holds",
+        "shed": "shed",
+        "defer": "deferred",
+    }.get(kind)
+    if counter is not None:
+        AUTOPILOT_STATS[counter] += 1
+    ev = AUTOPILOT_STATS["decisions"]
+    ev.append({"kind": kind, **detail})
+    if len(ev) > MAX_DECISIONS:
+        del ev[: MAX_DECISIONS // 2]
+
+
+@dataclass(frozen=True)
+class ControlSignals:
+    """One immutable snapshot of the fleet's control inputs."""
+
+    queue_depth: int            # pending requests across routable replicas
+    outstanding: int            # admitted-but-unfinished across replicas
+    wait_p50_ms: float          # recent submit->dispatch waits
+    wait_p99_ms: float
+    max_burn: float             # worst error-budget burn across keys
+    burn_by_key: Tuple[Tuple[str, float], ...]  # sorted (key, burn)
+    replicas: int               # routable replica count
+    total_replicas: int         # routable + draining
+    fence: int                  # router graph-version fence
+
+    def burn_of(self, tenant: Optional[str]) -> float:
+        """Burn of one tenant's objective key (0.0 when unknown)."""
+        key = f"tenant:{tenant}"
+        for k, v in self.burn_by_key:
+            if k == key:
+                return v
+        return 0.0
+
+
+#: how many recent waits feed the p50/p99 signal — a CURRENT load
+#: signal, not a lifetime average (a long calm history must not mask
+#: a fresh queue-wait spike)
+WAIT_WINDOW = 64
+
+
+class SignalReader:
+    """Snapshot router + queues + the federation into ControlSignals.
+
+    `router` is a FleetRouter (or None: a bare session is read as one
+    permanent replica via `session=`).  `window` bounds the hysteresis
+    deque the scaler's decide() consumes."""
+
+    def __init__(self, router=None, session=None, window: int = 3):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.router = router
+        self.session = session
+        self.window = int(window)
+        self._recent: deque = deque(maxlen=self.window)
+
+    # ---- one snapshot -----------------------------------------------------
+
+    def _sessions(self) -> List:
+        if self.router is not None:
+            return [r.session for r in self.router.replicas
+                    if r.routable]
+        return [self.session] if self.session is not None else []
+
+    def read(self) -> ControlSignals:
+        """Take one snapshot, append it to the hysteresis window, and
+        return it.  Never raises — the control plane reads telemetry,
+        it must not become a failure mode of the serve loop."""
+        depth = 0
+        waits: List[float] = []
+        for s in self._sessions():
+            q = s.queue
+            depth += q.pending()
+            waits.extend(q.admission_waits[-WAIT_WINDOW:])
+        from libgrape_lite_tpu.serve.queue import latency_summary_ms
+
+        lat = latency_summary_ms(waits)
+        slo_view = _federation.snapshot("slo") or {}
+        burn = dict(slo_view.get("burn_by_key") or {})
+        if self.router is not None:
+            routable = [r for r in self.router.replicas if r.routable]
+            sig = ControlSignals(
+                queue_depth=depth,
+                outstanding=sum(r.outstanding for r in routable),
+                wait_p50_ms=lat["p50_ms"],
+                wait_p99_ms=lat["p99_ms"],
+                max_burn=float(slo_view.get("max_burn") or 0.0),
+                burn_by_key=tuple(sorted(burn.items())),
+                replicas=len(routable),
+                total_replicas=len(self.router.replicas),
+                fence=self.router.fence,
+            )
+        else:
+            sig = ControlSignals(
+                queue_depth=depth,
+                outstanding=0,
+                wait_p50_ms=lat["p50_ms"],
+                wait_p99_ms=lat["p99_ms"],
+                max_burn=float(slo_view.get("max_burn") or 0.0),
+                burn_by_key=tuple(sorted(burn.items())),
+                replicas=1 if self.session is not None else 0,
+                total_replicas=1 if self.session is not None else 0,
+                fence=0,
+            )
+        self._recent.append(sig)
+        return sig
+
+    # ---- the hysteresis window --------------------------------------------
+
+    @property
+    def recent(self) -> Tuple[ControlSignals, ...]:
+        """Oldest-first window of the last `window` snapshots."""
+        return tuple(self._recent)
+
+    @property
+    def saturated(self) -> bool:
+        """True once the window is full — decide() refuses to act on a
+        part-filled window (one spike is not a trend)."""
+        return len(self._recent) >= self.window
+
+    def clear(self) -> None:
+        self._recent.clear()

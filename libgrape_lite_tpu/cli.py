@@ -171,13 +171,35 @@ def make_serve_parser() -> argparse.ArgumentParser:
                         "(GRAPE_FLEET_HBM_BYTES) with weighted "
                         "round-robin fairness and never share a "
                         "batched dispatch")
-    p.add_argument("--arrival_rate", type=float, default=0.0,
+    p.add_argument("--arrival_rate", default="",
                    help="threaded admission front (serve/feeder.py): "
                         "submit the stream at this rate from a feeder "
                         "thread with real wall-clock arrivals, so "
                         "--max_wait_ms and priority/deadline "
-                        "scheduling are exercised under load; 0 keeps "
-                        "the deterministic scripted mode")
+                        "scheduling are exercised under load; a plain "
+                        "QPS float, or a step schedule like "
+                        "'50:2x@100' (double the rate from query "
+                        "index 100 — the autopilot load-shift drill); "
+                        "0/empty keeps the deterministic scripted "
+                        "mode")
+    p.add_argument("--autopilot", action="store_true",
+                   help="autopilot/: close the observe->decide->act "
+                        "loop over a replica fleet — an Autoscaler "
+                        "scales replicas between --min_replicas and "
+                        "--max_replicas through the zero-drop "
+                        "drain/rejoin/replicate machinery, and a "
+                        "shared fence-epoch result cache "
+                        "(--cache_entries) answers repeated point "
+                        "queries without the device "
+                        "(docs/AUTOPILOT.md)")
+    p.add_argument("--min_replicas", type=int, default=1,
+                   help="autopilot: replica floor (and the initial "
+                        "replica count)")
+    p.add_argument("--max_replicas", type=int, default=4,
+                   help="autopilot: replica ceiling")
+    p.add_argument("--cache_entries", type=int, default=1024,
+                   help="autopilot: result-cache capacity in entries "
+                        "(0 disables the cache)")
     p.add_argument("--delta_stream", default="",
                    help="dyn/ live ingest: a delta-op stream file "
                         "('a src dst [w]' / 'd src dst' / 'u src dst "
@@ -409,17 +431,48 @@ def serve_main(argv=None):
         delta_ops = parse_ops_file(
             ns.delta_stream, weighted=weighted, string_id=ns.string_id
         )
+    # --arrival_rate: a float or a step spec ("50:2x@100") — validate
+    # BEFORE the load; "0" keeps the legacy disabled meaning
+    if ns.arrival_rate:
+        try:
+            if float(ns.arrival_rate) == 0.0:
+                ns.arrival_rate = ""
+        except ValueError:
+            pass
+    if ns.arrival_rate:
+        from libgrape_lite_tpu.serve.feeder import parse_rate_spec
+
+        try:
+            parse_rate_spec(ns.arrival_rate)
+        except ValueError as e:
+            sys.exit(f"serve: {e}")
     fleet_mode = ns.replicas > 1 or bool(ns.tenants)
     if ns.drain_at >= 0 and ns.replicas < 2:
         sys.exit("serve: --drain_at needs --replicas >= 2 (draining "
                  "the only replica would drop traffic)")
-    if fleet_mode and ns.arrival_rate:
+    if ns.autopilot:
+        # the autopilot runs its OWN fleet loop — it owns replica
+        # count (min/max), so the static fleet knobs don't compose
+        for flag, bad in (("--tenants", bool(ns.tenants)),
+                          ("--drain_at", ns.drain_at >= 0),
+                          ("--delta_stream", bool(ns.delta_stream))):
+            if bad:
+                sys.exit(f"serve: --autopilot does not compose with "
+                         f"{flag} yet")
+        if ns.min_replicas < 1:
+            sys.exit("serve: --min_replicas must be >= 1")
+        if ns.max_replicas < ns.min_replicas:
+            sys.exit("serve: --max_replicas must be >= --min_replicas")
+    elif fleet_mode and ns.arrival_rate:
         sys.exit("serve: --arrival_rate does not compose with "
                  "--replicas/--tenants yet")
     spec = LoadGraphSpec(
         directed=ns.directed, weighted=weighted,
         string_id=ns.string_id, edata_dtype=np.float64,
-        retain_edge_list=bool(ns.delta_stream) or ns.replicas > 1,
+        # autopilot scale-ups replicate fresh fragments from the
+        # retained edge list, exactly like --replicas
+        retain_edge_list=bool(ns.delta_stream) or ns.replicas > 1
+        or ns.autopilot,
     )
     with timer.phase("load graph"):
         frag = LoadGraph(ns.efile, ns.vfile or None,
@@ -439,6 +492,8 @@ def serve_main(argv=None):
     policy = BatchPolicy(max_batch=ns.max_batch,
                          max_wait_s=ns.max_wait_ms / 1e3)
 
+    if ns.autopilot:
+        return _serve_autopilot(ns, frag, queries, policy, dyn_policy)
     if fleet_mode:
         return _serve_fleet(ns, frag, queries, delta_ops, policy,
                             dyn_policy)
@@ -645,6 +700,140 @@ def _serve_fleet(ns, frag, queries, delta_ops, policy, dyn_policy):
     )
 
 
+def _serve_autopilot(ns, frag, queries, policy, dyn_policy):
+    """The closed-loop serving path (autopilot/, docs/AUTOPILOT.md):
+    a replica fleet whose size the Autoscaler moves between
+    --min_replicas and --max_replicas from live queue/burn signals,
+    with a shared fence-epoch result cache in front of the device.
+    With --arrival_rate the stream arrives on a feeder thread (the
+    rate may STEP mid-stream: '50:2x@100') while this thread routes,
+    pumps, and ticks the control loop; without it the scripted stream
+    submits up front and the loop still ticks between pumps."""
+    import sys  # noqa: F401  (parity with the sibling drivers)
+    import time
+    from collections import deque
+
+    from libgrape_lite_tpu.autopilot import (
+        Autoscaler,
+        ResultCache,
+        ScalerConfig,
+    )
+    from libgrape_lite_tpu.autopilot.signals import AUTOPILOT_STATS
+    from libgrape_lite_tpu.fleet import (
+        FLEET_STATS,
+        FleetBudget,
+        FleetRouter,
+    )
+    from libgrape_lite_tpu.fragment.mutation import replicate_fragment
+    from libgrape_lite_tpu.serve import ServeSession
+
+    # per-run record discipline (the _serve_fleet PUMP_STATS rule):
+    # process-global stats reset first
+    FLEET_STATS.reset()
+    AUTOPILOT_STATS.reset()
+
+    def make_session(f):
+        return ServeSession(
+            f, policy=policy, guard=ns.guard or None, dyn=dyn_policy(),
+        )
+
+    n0 = max(1, ns.min_replicas, ns.replicas)
+    frags = [frag] + [replicate_fragment(frag) for _ in range(n0 - 1)]
+    sessions = [make_session(f) for f in frags]
+    router = FleetRouter(sessions, window=max(1, ns.inflight))
+    cache = None
+    if ns.cache_entries > 0:
+        cache = ResultCache(capacity=ns.cache_entries)
+        router.attach_cache(cache)
+    cfg = ScalerConfig(
+        min_replicas=n0, max_replicas=max(n0, ns.max_replicas),
+    )
+    autopilot = Autoscaler(
+        router, cfg, session_factory=make_session, budget=FleetBudget(),
+    )
+
+    def busy():
+        return any(
+            r.session.queue.pending() or r.pump.inflight()
+            for r in router.replicas
+        )
+
+    stream = [
+        {"app": app_key, "args": {"source": src},
+         "max_rounds": ns.max_rounds or None}
+        for app_key, src in queries
+    ]
+    reqs = []
+    t0 = time.perf_counter()
+    if ns.arrival_rate:
+        from libgrape_lite_tpu.serve import ArrivalFeeder
+
+        # the feeder thread only APPENDS arrivals; this thread alone
+        # touches the router (submit/pump/tick), so the fleet stays
+        # single-threaded like every other driver
+        inbox: deque = deque()
+
+        def enqueue(app_key, args, **kw):
+            inbox.append((app_key, args, kw))
+
+        feeder = ArrivalFeeder(enqueue, stream, ns.arrival_rate)
+        feeder.start()
+        while feeder.is_alive() or inbox or busy():
+            moved = 0
+            while inbox:
+                app_key, args, kw = inbox.popleft()
+                reqs.append(router.submit(app_key, args, **kw))
+                moved += 1
+            got = router.pump()
+            autopilot.tick()
+            if not got and not moved:
+                time.sleep(1e-4)
+        feeder.join()
+    else:
+        for item in stream:
+            reqs.append(router.submit(
+                item["app"], item["args"],
+                max_rounds=item["max_rounds"],
+            ))
+            router.pump()
+            autopilot.tick()
+        while busy():
+            router.pump()
+            autopilot.tick()
+    router.drain()
+    wall = time.perf_counter() - t0
+    results = [q.result for q in reqs if q.result is not None]
+
+    routable = [r for r in router.replicas if r.routable]
+    ap = AUTOPILOT_STATS.snapshot()
+    autopilot_block = {
+        "min_replicas": cfg.min_replicas,
+        "max_replicas": cfg.max_replicas,
+        "replicas_final": len(routable),
+        "replicas_peak": len(router.replicas),
+        **{k: ap[k] for k in (
+            "ticks", "scale_ups", "scale_downs", "holds", "shed",
+            "deferred", "cache_hits", "cache_misses", "cache_stores",
+        )},
+    }
+    if cache is not None:
+        autopilot_block["cache"] = cache.snapshot()
+    fleet_block = {
+        "replicas": len(router.replicas),
+        "tenants": 0,
+        "fence": router.fence,
+        "dropped": len(reqs) - len(results),
+        **FLEET_STATS.snapshot(),
+        "router": router.summary(wall),
+    }
+    return _serve_summary(
+        ns, router.replicas[0].session, None, reqs, results, wall,
+        [], fleet_block=fleet_block,
+        sessions=[r.session for r in router.replicas],
+        autopilot_block=autopilot_block,
+    )
+
+
 def _per_app_latency_ms(results) -> dict:
     """Per-app p50/p99 latency next to the global one — the fleet
     bench's per-workload view of a mixed stream."""
@@ -661,7 +850,8 @@ def _per_app_latency_ms(results) -> dict:
 
 
 def _serve_summary(ns, sess, pump, reqs, results, wall, delta_ops,
-                   fleet_block=None, sessions=None):
+                   fleet_block=None, sessions=None,
+                   autopilot_block=None):
     """Build + print the serve summary record (shared by the plain,
     feeder and fleet paths).  `sessions` (fleet) merges batch
     histograms and admission waits across replicas/tenant sessions;
@@ -757,6 +947,8 @@ def _serve_summary(ns, sess, pump, reqs, results, wall, delta_ops,
         }
     if fleet_block is not None:
         record["fleet"] = fleet_block
+    if autopilot_block is not None:
+        record["autopilot"] = autopilot_block
     if ns.dump_results:
         # submit-order identity surface: one line per query with a
         # digest of its assembled values — byte-comparable across

@@ -90,12 +90,49 @@ class FleetRouter:
     def __init__(self, sessions, *, window: int = 1):
         if not sessions:
             raise ValueError("router needs at least one replica session")
+        self._window = int(window)
         self.replicas = [
             Replica(i, s, window) for i, s in enumerate(sessions)
         ]
         self.fence = 0
         self._live: List[tuple] = []  # (QueryRequest, Replica)
         self.stats = {"routed": 0, "ingests": 0, "drains": 0}
+        # shared result cache (autopilot/cache.py), attach_cache-wired:
+        # the fence IS its invalidation epoch
+        self.cache = None
+
+    # ---- elasticity (autopilot/scaler.py) ---------------------------------
+
+    def add_replica(self, session) -> Replica:
+        """Join a NEW replica session at the current fence — the
+        autoscaler's scale-up actuator.  The session must hold a
+        content-identical copy of the current graph
+        (`fragment.mutation.replicate_fragment` of a live replica's
+        fragment — deterministic, so the newcomer answers
+        byte-identically).  Routable immediately; recorded in
+        FLEET_STATS like every drain/rejoin."""
+        r = Replica(len(self.replicas), session, self._window)
+        r.version = self.fence
+        self.replicas.append(r)
+        if self.cache is not None:
+            session.attach_result_cache(
+                self.cache, epoch=lambda: self.fence
+            )
+        FLEET_STATS.record("add_replica", replica=r.idx,
+                           fence=self.fence)
+        return r
+
+    def attach_cache(self, cache) -> None:
+        """Share one ResultCache (autopilot/cache.py) across every
+        replica, with the router fence as the invalidation epoch: a
+        hit computed by ANY replica is valid fleet-wide (replicas are
+        byte-identical at a fence), and `ingest` reaps the stale
+        epoch wholesale after bumping it."""
+        self.cache = cache
+        for r in self.replicas:
+            r.session.attach_result_cache(
+                cache, epoch=lambda: self.fence
+            )
 
     # ---- routing ----------------------------------------------------------
 
@@ -225,6 +262,12 @@ class FleetRouter:
             else:
                 r.catchup.append((self.fence, ops, force_repack))
         self.stats["ingests"] += 1
+        if self.cache is not None:
+            # the fence moved: the previous epoch's cached answers are
+            # answers about a graph that no longer exists — reap them
+            # wholesale (lookups at the new fence structurally miss
+            # them anyway; this frees the memory and counts the kill)
+            self.cache.invalidate_stale(self.fence)
         tr = obs.tracer()
         if tr.enabled:
             tr.instant(

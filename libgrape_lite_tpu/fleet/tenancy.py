@@ -112,6 +112,18 @@ class FleetManager:
         self.budget = budget or FleetBudget()
         self.tenants: Dict[str, Tenant] = {}
         self.forward_order: List[str] = []  # tenant name per forward
+        # optional control plane (autopilot/): when attached, every
+        # manager pump ticks the observe->decide->act loop — scaling,
+        # shedding, and caching ride the ordinary serve cadence
+        self.autopilot = None
+
+    def attach_autopilot(self, autopilot) -> None:
+        """Own an Autoscaler (autopilot/scaler.py): `pump` ticks it
+        once per pass, so the control loop runs at the serve cadence
+        without its own thread.  The scaler's budget should be THIS
+        manager's budget, so scale-ups and tenant admissions price
+        against one capacity."""
+        self.autopilot = autopilot
 
     def add_tenant(self, name: str, target, *,
                    weight: float = 1.0) -> Tenant:
@@ -238,13 +250,17 @@ class FleetManager:
 
     def pump(self) -> List:
         """One fleet step: a WRR forward cycle, then one pump pass
-        over every distinct target.  Returns this step's results."""
+        over every distinct target.  Returns this step's results.
+        With an autopilot attached, one control tick runs after the
+        pass (never raises — Autoscaler.tick contains its own acts)."""
         self.forward_round()
         out = []
         for target in self._targets():
             out.extend(target.pump(force=True)
                        if _takes_force(target) else target.pump())
         self._account(out)
+        if self.autopilot is not None:
+            self.autopilot.tick()
         return out
 
     def drain(self) -> List:
@@ -280,11 +296,18 @@ class FleetManager:
                 "p50_ms": lat["p50_ms"],
                 "p99_ms": lat["p99_ms"],
             }
-        return {
+        out = {
             "tenants": per_tenant,
             "budget": self.budget.snapshot(),
             "fleet": FLEET_STATS.snapshot(),
         }
+        if self.autopilot is not None:
+            from libgrape_lite_tpu.autopilot.signals import (
+                AUTOPILOT_STATS,
+            )
+
+            out["autopilot"] = AUTOPILOT_STATS.snapshot()
+        return out
 
 
 def _takes_force(target) -> bool:

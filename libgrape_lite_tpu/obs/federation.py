@@ -51,6 +51,7 @@ EXPECTED: Dict[str, str] = {
     "fleet": "libgrape_lite_tpu.fleet.budget",
     "slo": "libgrape_lite_tpu.obs.slo",
     "recorder": "libgrape_lite_tpu.obs.recorder",
+    "autopilot": "libgrape_lite_tpu.autopilot.signals",
 }
 
 

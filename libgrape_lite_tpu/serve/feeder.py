@@ -20,41 +20,100 @@ thread and the pump thread never race on the pending list.  The
 deterministic scripted mode (no feeder) is byte-for-bit untouched —
 this module only ADDS a second producer.
 
-The CLI surface is `serve --arrival_rate QPS`.
+The CLI surface is `serve --arrival_rate QPS` — where QPS is either
+a plain float or a **step schedule** like ``"50:2x@100"`` (start at
+50 qps, double the rate from query index 100 on).  Steps chain:
+``"50:2x@100:0.5x@300"``.  The schedule is what makes load-shift
+drills (autopilot/ scale-up under a rate step) reproducible.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List
+from typing import Callable, List, Tuple
+
+
+def parse_rate_spec(spec) -> Tuple[float, List[Tuple[int, float]]]:
+    """``"50:2x@100"`` -> ``(50.0, [(100, 2.0)])``: a base rate plus
+    ``(index, multiplier)`` steps applied cumulatively from that
+    arrival index on.  A bare number (or numeric string) has no
+    steps.  Raises ValueError on malformed specs."""
+    if isinstance(spec, (int, float)):
+        base, steps = float(spec), []
+    else:
+        parts = str(spec).split(":")
+        base = float(parts[0])
+        steps = []
+        last_idx = 0
+        for part in parts[1:]:
+            try:
+                mult_s, idx_s = part.split("@")
+                if not mult_s.endswith("x"):
+                    raise ValueError
+                mult = float(mult_s[:-1])
+                idx = int(idx_s)
+            except ValueError:
+                raise ValueError(
+                    f"bad rate step {part!r} in {spec!r} "
+                    "(want MULTx@INDEX, e.g. 2x@100)"
+                ) from None
+            if mult <= 0:
+                raise ValueError(f"rate multiplier must be > 0: {part!r}")
+            if idx <= last_idx:
+                raise ValueError(
+                    f"rate steps must have increasing indices: {spec!r}"
+                )
+            steps.append((idx, mult))
+            last_idx = idx
+    if base <= 0:
+        raise ValueError(f"rate_qps must be > 0, got {base}")
+    return base, steps
+
+
+def arrival_offsets(n: int, base: float,
+                    steps: List[Tuple[int, float]]) -> List[float]:
+    """Precomputed arrival offset (seconds from t0) for each of `n`
+    arrivals under the step schedule: arrival i+1 follows arrival i
+    by 1/rate(i), where rate(i) is the base times every multiplier
+    whose step index is <= i."""
+    out, t, rate = [], 0.0, float(base)
+    pending = list(steps)
+    for i in range(n):
+        while pending and pending[0][0] <= i:
+            rate *= pending.pop(0)[1]
+        out.append(t)
+        t += 1.0 / rate
+    return out
 
 
 class ArrivalFeeder(threading.Thread):
     """Submit `stream` items through `submit_fn` at `rate_qps`
-    arrivals/second.  Items are (app_key, args) pairs or dicts in the
-    `ServeSession.serve` format (optionally carrying max_rounds /
-    guard / priority / deadline_s / tenant).  Submitted requests
-    accumulate in `self.requests` in arrival order."""
+    arrivals/second — a float, or a step-schedule string like
+    ``"50:2x@100"`` (see `parse_rate_spec`).  Items are (app_key,
+    args) pairs or dicts in the `ServeSession.serve` format
+    (optionally carrying max_rounds / guard / priority / deadline_s /
+    tenant).  Submitted requests accumulate in `self.requests` in
+    arrival order."""
 
-    def __init__(self, submit_fn: Callable, stream, rate_qps: float,
+    def __init__(self, submit_fn: Callable, stream, rate_qps,
                  name: str = "grape-feeder"):
         super().__init__(name=name, daemon=True)
-        if rate_qps <= 0:
-            raise ValueError(f"rate_qps must be > 0, got {rate_qps}")
+        base, steps = parse_rate_spec(rate_qps)
         self._submit = submit_fn
         self._stream = list(stream)
-        self.rate_qps = float(rate_qps)
+        self.rate_qps = base  # base rate (back-compat float surface)
+        self.rate_steps = steps
+        self._offsets = arrival_offsets(len(self._stream), base, steps)
         self.requests: List = []
         self.submitted = 0
 
     def run(self) -> None:
-        period = 1.0 / self.rate_qps
         t0 = time.perf_counter()
         for i, item in enumerate(self._stream):
-            # absolute schedule (t0 + i*period), not sleep(period):
+            # absolute schedule (t0 + offset[i]), not sleep(period):
             # a slow submit must not stretch every later arrival
-            delay = t0 + i * period - time.perf_counter()
+            delay = t0 + self._offsets[i] - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
             if isinstance(item, dict):

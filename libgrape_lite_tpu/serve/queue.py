@@ -190,11 +190,26 @@ class AdmissionQueue:
         self._lock = threading.Lock()
         self.batch_hist: Dict[int, int] = {}
         self.completed = 0
-        # deadline-expired requests failed (never silently dropped):
-        # count here, reason on each result, results returned by the
-        # next pump/drain via take_expired()
+        # deadline-expired and shed requests failed (never silently
+        # dropped): counted here, reason on each result, results
+        # returned by the next pump/drain via take_expired()
         self.expired = 0
+        self.shed = 0
         self._expired_out: List[ServeResult] = []
+        # optional admission-control hook (autopilot/admission.py):
+        # callable(req) -> "admit" | "defer" | "shed", consulted by
+        # the _pop_ready sweep BEFORE coalescing — shed requests fail
+        # loudly (reason=shed_over_budget), deferred tenants queue
+        # behind in-budget ones
+        self.admission = None
+        # optional result cache (autopilot/cache.py): deliver() stores
+        # every OK result under its full identity; cache_meta(req)
+        # returns (compat, source) for cacheable requests (None
+        # otherwise) and cache_epoch() the current fence epoch — both
+        # wired by ServeSession.attach_result_cache
+        self.result_cache = None
+        self.cache_meta = None
+        self.cache_epoch = None
         # per-request submit->dispatch wait (seconds), recorded at pop
         # time next to the batch-size histogram: the admission-latency
         # half of the serving story (the histogram says how well the
@@ -247,6 +262,14 @@ class AdmissionQueue:
                 self.expired += 1
                 self.completed += 1
                 swept.append(req.id)
+                # a query that never dispatched still BURNS its
+                # tenant's error budget — without this, the tenant
+                # that caused a deadline storm never paid for it
+                # (slo.observe never raises and takes no queue locks;
+                # safe under the queue lock like the recorder below)
+                from libgrape_lite_tpu.obs import slo
+
+                slo.observe(req.app_key, req.tenant, waited, ok=False)
             else:
                 live.append(req)
         self._pending = live
@@ -269,22 +292,89 @@ class AdmissionQueue:
                     "pending": len(self._pending),
                 })
 
+    def _review_admission(self) -> set:
+        """Run the attached admission hook over the pending list:
+        shed requests fail loudly (the deadline-expiry discipline —
+        counted, reasoned, SLO-observed, returned via take_expired),
+        deferred requests stay queued but their tenants are returned
+        so _head_batch serves in-budget tenants first.  Caller holds
+        the lock."""
+        deferred: set = set()
+        if self.admission is None:
+            return deferred
+        live: List[QueryRequest] = []
+        shed_n = 0
+        for req in self._pending:
+            try:
+                verdict = self.admission(req)
+            except Exception:
+                verdict = "admit"  # a broken hook must not wedge admission
+            if verdict == "shed":
+                waited = time.perf_counter() - req.submitted_s
+                res = ServeResult(
+                    request_id=req.id, app_key=req.app_key, ok=False,
+                    error={
+                        "error": "shed: tenant over error budget",
+                        "reason": "shed_over_budget",
+                        "tenant": req.tenant or "",
+                        "waited_s": round(waited, 6),
+                    },
+                    latency_s=waited,
+                    stages={"queue_wait_us": int(waited * 1e6)},
+                )
+                req.result = res
+                self._expired_out.append(res)
+                self.shed += 1
+                self.completed += 1
+                shed_n += 1
+                # shedding burns the shed tenant's budget too — the
+                # same accounting rule as deadline expiry above
+                from libgrape_lite_tpu.obs import slo
+
+                slo.observe(req.app_key, req.tenant, waited, ok=False)
+            else:
+                if verdict == "defer":
+                    deferred.add(req.tenant)
+                live.append(req)
+        self._pending = live
+        if shed_n:
+            from libgrape_lite_tpu.obs.recorder import RECORDER
+
+            RECORDER.record("shed_over_budget", n=shed_n)
+        return deferred
+
     def take_expired(self) -> List[ServeResult]:
-        """Drain the deadline-expired results (pump/drain and the
-        async pump call this so an expired request is always RETURNED
-        to the driver, never silently dropped)."""
+        """Drain the out-of-band results — deadline-expired and shed
+        failures, plus cache-hit results that never dispatched
+        (pump/drain and the async pump call this so such a request is
+        always RETURNED to the driver, never silently dropped)."""
         with self._lock:
             out, self._expired_out = self._expired_out, []
         return out
 
-    def _head_batch(self) -> List[QueryRequest]:
+    def push_oob(self, res: ServeResult) -> None:
+        """Append one out-of-band result (a cache hit served without
+        dispatching — serve/session.py) to the take_expired channel,
+        so every pump/drain surface returns it like any other."""
+        with self._lock:
+            self._expired_out.append(res)
+            self.completed += 1
+
+    def _head_batch(self, deferred: set = frozenset()
+                    ) -> List[QueryRequest]:
         """The head request plus the next compatible requests in FIFO
         order, up to max_batch lanes.  The head is the FIRST request
         of the HIGHEST priority class present (FIFO within a class);
         only same-class requests may join its batch, so a low-priority
-        straggler never rides an urgent dispatch."""
-        top = max(r.priority for r in self._pending)
-        head = next(r for r in self._pending if r.priority == top)
+        straggler never rides an urgent dispatch.  Tenants in
+        `deferred` (admission control: past error budget) queue
+        BEHIND everyone else: they only head a batch when nothing
+        in-budget is pending, so deferral never becomes starvation."""
+        cands = [r for r in self._pending if r.tenant not in deferred]
+        if not cands:
+            cands = self._pending
+        top = max(r.priority for r in cands)
+        head = next(r for r in cands if r.priority == top)
         key = self._compat(head)
         batch = [head]
         seen_head = False
@@ -305,15 +395,17 @@ class AdmissionQueue:
         """Pop at most ONE ready batch off the queue — the policy
         decision shared by the synchronous `pump` and the async pump's
         dispatch stage (serve/pipeline.py).  Ready = full, head waited
-        `max_wait_s`, or `force`d.  Expires overdue deadlines first
-        (failed results, via take_expired).  Records each popped
-        request's submit->dispatch wait.  [] = nothing ready."""
+        `max_wait_s`, or `force`d.  Expires overdue deadlines and runs
+        the admission hook first (failed results, via take_expired).
+        Records each popped request's submit->dispatch wait.
+        [] = nothing ready."""
         now = time.perf_counter() if now is None else now
         with self._lock:
             self._expire_overdue(now)
+            deferred = self._review_admission()
             if not self._pending:
                 return []
-            batch = self._head_batch()
+            batch = self._head_batch(deferred)
             if not force and len(batch) < self.policy.max_batch:
                 head_wait = now - batch[0].submitted_s
                 if head_wait < self.policy.max_wait_s:
@@ -367,6 +459,17 @@ class AdmissionQueue:
             # objectives are configured; never raises)
             slo.observe(req.app_key, req.tenant, res.latency_s,
                         res.ok)
+            # result-cache store (autopilot/cache.py), same shared
+            # site: sync loop, async pump, and fleet replicas all
+            # deliver here, so every cacheable OK result is stored
+            # regardless of serving mode.  The key carries the FULL
+            # compat identity + source + fence epoch (grape-lint R9).
+            if self.result_cache is not None and res.ok:
+                meta = self.cache_meta(req) if self.cache_meta else None
+                if meta is not None:
+                    compat, source = meta
+                    fence = self.cache_epoch() if self.cache_epoch else 0
+                    self.result_cache.store(compat, source, fence, res)
         self.batch_hist[len(batch)] = (
             self.batch_hist.get(len(batch), 0) + 1
         )

@@ -86,9 +86,15 @@ class ServeSession:
         self._workers: Dict[str, Worker] = {}
         self._pump = None  # the attached AsyncServePump, if any
         self._closed = False
+        # optional result cache (autopilot/cache.py) + its epoch
+        # source; a bare session's epoch is its own ingest counter, a
+        # fleet replica's is the router fence (attach_result_cache)
+        self._cache = None
+        self._cache_epoch = None
+        self._ingest_epoch = 0
         self.stats = {
             "queries": 0, "batches": 0, "failed": 0,
-            "sequential_fallbacks": 0,
+            "sequential_fallbacks": 0, "cache_hits": 0,
             "ingested_ops": 0, "overlay_applies": 0, "repacks": 0,
             "forced_repacks": 0,
         }
@@ -216,6 +222,18 @@ class ServeSession:
         )
         if self.dyn.fragment is not self.fragment:
             self._adopt_fragment()
+        if report.get("staged", 0):
+            # a content-changing ingest advances the cache epoch (an
+            # empty forced repack preserves every answer and must NOT
+            # kill the cache); a session owning its own epoch reaps
+            # the stale one here — a fleet replica's router does this
+            # at the fence bump instead (fleet/router.py)
+            self._ingest_epoch += 1
+            if self._cache is not None and self._cache_epoch is not None:
+                try:
+                    self._cache.invalidate_stale(self._cache_epoch())
+                except Exception:
+                    pass
         return report
 
     def _adopt_fragment(self) -> None:
@@ -246,13 +264,14 @@ class ServeSession:
 
     # ---- admission --------------------------------------------------------
 
-    def _compat_key(self, req: QueryRequest) -> tuple:
+    def _compat_for(self, app_key: str, args: dict, max_rounds,
+                    guard, tenant) -> tuple:
         # an unknown app must not raise here: the queue calls this
         # while PICKING the next batch, and a raise would wedge the
         # head of the queue forever — the dispatch path turns the
         # lookup failure into per-request error results instead
-        if req.app_key not in self.apps:
-            return (req.app_key, "?unknown", req.tenant)
+        if app_key not in self.apps:
+            return (app_key, "?unknown", tenant)
         # batch_query_key is a CLASS attribute: read it off the
         # registered app class directly — instantiating the resident
         # Worker here (as this method once did) built state and pack
@@ -262,10 +281,99 @@ class ServeSession:
         # tenants never share a batched dispatch — one tenant's
         # poisoned lane can never fail a batchmate tenant (fleet/).
         return compat_key(
-            req.app_key, req.args, req.max_rounds,
-            req.guard or self.guard,
-            getattr(self.apps[req.app_key], "batch_query_key", None),
-        ) + (req.tenant,)
+            app_key, args, max_rounds, guard or self.guard,
+            getattr(self.apps[app_key], "batch_query_key", None),
+        ) + (tenant,)
+
+    def _compat_key(self, req: QueryRequest) -> tuple:
+        return self._compat_for(req.app_key, req.args, req.max_rounds,
+                                req.guard, req.tenant)
+
+    # ---- result cache / admission control (autopilot/) --------------------
+
+    def attach_result_cache(self, cache, epoch=None) -> None:
+        """Wire a ResultCache (autopilot/cache.py) into this session:
+        `submit` probes it BEFORE the request enters coalescing, and
+        the queue's `deliver` stores every cacheable OK result.
+        `epoch` supplies the invalidation fence (the FleetRouter
+        passes its own `lambda: router.fence`); a bare session uses
+        its ingest counter — any content-changing ingest bumps it and
+        the stale epoch dies wholesale."""
+        self._cache = cache
+        self._cache_epoch = epoch or (lambda: self._ingest_epoch)
+        self.queue.result_cache = cache
+        self.queue.cache_meta = self._cache_meta
+        self.queue.cache_epoch = self._cache_epoch
+
+    def attach_admission(self, controller) -> None:
+        """Wire an AdmissionController (autopilot/admission.py): the
+        queue's pop sweep sheds/defers over-budget tenants before
+        coalescing."""
+        self.queue.admission = controller.review
+
+    def _cacheable(self, app_key: str, args: dict, guard):
+        """The lane source when (app_key, args, guard) is cacheable —
+        a point query (batch_query_key contract) with its lane arg
+        present and no guard armed (guarded runs carry verdicts a
+        cache must not replay) — else None."""
+        if self._cache is None or (guard or self.guard) is not None:
+            return None
+        app = self.apps.get(app_key)
+        bq = getattr(app, "batch_query_key", None) if app else None
+        if bq is None:
+            return None
+        return args.get(bq)
+
+    def _cache_meta(self, req: QueryRequest):
+        """(compat, source) for a cacheable request, else None — the
+        queue's deliver() store hook."""
+        source = self._cacheable(req.app_key, req.args, req.guard)
+        if source is None:
+            return None
+        return (self._compat_key(req), source)
+
+    def _deliver_cached(self, app_key: str, args: dict, entry, *,
+                        max_rounds, priority, deadline_s,
+                        tenant) -> QueryRequest:
+        """Serve one cache hit WITHOUT dispatching: mint the request +
+        result pair, stamp zeroed stages (no queue wait, no device
+        time — honest, not missing), emit a `serve_query` span with
+        ``cached=true``, run the SAME `slo.observe` accounting as a
+        delivered result, and push it on the queue's out-of-band
+        channel so every pump/drain surface returns it."""
+        import time as _time
+
+        from libgrape_lite_tpu.obs import slo
+
+        t0_ns = _time.perf_counter_ns()
+        req = QueryRequest(
+            app_key=app_key, args=dict(args), max_rounds=max_rounds,
+            priority=int(priority), deadline_s=deadline_s,
+            tenant=tenant,
+        )
+        req.popped_s = req.submitted_s
+        vals, rounds, code = entry
+        res = ServeResult(
+            request_id=req.id, app_key=app_key, ok=True, values=vals,
+            rounds=rounds, terminate_code=code, batch_size=1,
+            stages={"queue_wait_us": 0, "window_wait_us": 0,
+                    "dispatch_us": 0, "device_us": 0, "harvest_us": 0},
+        )
+        res.latency_s = _time.perf_counter() - req.submitted_s
+        req.result = res
+        self.stats["cache_hits"] += 1
+        slo.observe(app_key, tenant, res.latency_s, True)
+        tr = obs.tracer()
+        if tr.enabled:
+            tr.emit_span_raw(
+                "serve_query", t0_ns=t0_ns,
+                dur_ns=_time.perf_counter_ns() - t0_ns,
+                tid=tr.lane_tid(0), query_id=req.id, app=app_key,
+                lane=0, rounds=rounds, ok=True, cached=True,
+                tenant=tenant or "", queue_wait_us=0,
+            )
+        self.queue.push_oob(res)
+        return req
 
     def submit(self, app_key: str, args: dict | None = None, *,
                max_rounds: int | None = None,
@@ -274,6 +382,22 @@ class ServeSession:
                tenant: str | None = None) -> QueryRequest:
         if self._closed:
             raise RuntimeError("session is closed")
+        args = dict(args or {})
+        # result-cache probe BEFORE coalescing (autopilot/cache.py): a
+        # hit never enters the queue at all — the device, the batch
+        # planner, and the admission sweep all skip it
+        source = self._cacheable(app_key, args, guard)
+        if source is not None:
+            compat = self._compat_for(app_key, args, max_rounds,
+                                      guard, tenant)
+            fence = self._cache_epoch()
+            entry = self._cache.lookup(compat, source, fence)
+            if entry is not None:
+                return self._deliver_cached(
+                    app_key, args, entry, max_rounds=max_rounds,
+                    priority=priority, deadline_s=deadline_s,
+                    tenant=tenant,
+                )
         return self.queue.submit(
             app_key, args, max_rounds=max_rounds, guard=guard,
             priority=priority, deadline_s=deadline_s, tenant=tenant,

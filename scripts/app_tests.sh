@@ -347,8 +347,43 @@ print(f"  OK (cmp-identical; fence={fl['router']['fence']}, "
       + ")")
 EOF
 
+echo "== autopilot: closed-loop serve with a repeated-source stream (fnum=2) =="
+# the control-plane smoke (autopilot/, docs/AUTOPILOT.md): a
+# repeated-source stream (4 sources x 6 cycles) through
+# `serve --autopilot` — repeats of an already-answered (app, source)
+# pair must come out of the fence-epoch result cache instead of the
+# device (cache_hits asserted), every query must still succeed, and
+# the summary must carry the autopilot block (ticks, scale counters,
+# cache snapshot)
+python - > "$OUT/ap_stream.txt" <<'EOF'
+for cycle in range(6):
+    for s in (6, 7, 8, 9):
+        print("sssp", s)
+EOF
+python -m libgrape_lite_tpu.cli serve \
+  --efile "$DS/p2p-31.e" --vfile "$DS/p2p-31.v" $PLATFORM_ARGS --fnum 2 \
+  --stream "$OUT/ap_stream.txt" --max_batch 4 \
+  --autopilot --min_replicas 1 --max_replicas 2 \
+  > "$OUT/ap_serve.json"
+python - "$OUT/ap_serve.json" <<'EOF'
+import json, sys
+rec = json.loads(
+    [l for l in open(sys.argv[1]) if l.startswith("{")][-1])
+assert rec["queries"] == 24 and rec["failed"] == 0, rec
+ap = rec["autopilot"]
+assert ap["ticks"] >= 24, ap
+assert ap["cache_hits"] >= 8, ap  # repeats answered off-device
+assert ap["cache"]["entries"] >= 4, ap["cache"]
+assert ap["replicas_final"] >= ap["min_replicas"], ap
+assert rec["fleet"]["dropped"] == 0, rec["fleet"]
+print(f"  OK (24 queries, {ap['cache_hits']} cache hit(s) of "
+      f"{ap['cache_hits'] + ap['cache_misses']} probes, "
+      f"{ap['ticks']} control ticks, "
+      f"{ap['replicas_final']} replica(s))")
+EOF
+
 echo "== grape-lint: static contract rules, zero unsuppressed findings =="
-# the AST gate (R1-R8, analysis/): exits 1 on any finding the
+# the AST gate (R1-R9, analysis/): exits 1 on any finding the
 # baseline does not name, 3 if the --json record drifts from its own
 # declared schema — both fail this harness (set -e)
 python scripts/grape_lint.py --json > "$OUT/lint.json"

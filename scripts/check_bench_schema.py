@@ -295,6 +295,39 @@ _STAGE_POINT = {
     "p99": (_NUM, True),
 }
 
+# the r16 autopilot lane (autopilot/, docs/AUTOPILOT.md): the
+# closed-loop drill — the feeder's arrival rate steps up mid-stream
+# (rate_spec, serve/feeder.py) and the scaler must answer with at
+# least one zero-drop scale-up through the drain/rejoin/replicate
+# machinery while every answer stays byte-identical to a static-R
+# scripted run; plus the result-cache sub-drill: repeated sources
+# answered from the cache with ZERO XLA compiles, then one
+# fence-bumping ingest invalidates the epoch and the post-ingest
+# answers are byte-identical to a cold run on the mutated graph.
+# Verdict fields are DECLARED bool, like the pipeline lane's.
+_AUTOPILOT = {
+    "scale": (int, True),
+    "queries": (int, True),
+    "ok": (int, True),
+    "dropped": (int, True),
+    "rate_spec": (str, True),
+    "min_replicas": (int, True),
+    "max_replicas": (int, True),
+    "replicas_final": (int, True),
+    "scale_ups": (int, True),
+    "scale_downs": (int, True),
+    "ticks": (int, True),
+    "p99_ms": (_NUM, True),
+    "p99_bound_ms": (_NUM, True),
+    "p99_ok": (bool, True),
+    "byte_identical": (bool, True),
+    "cache_hits": (int, True),
+    "cache_misses": (int, True),
+    "cache_hit_compiles": (int, True),
+    "cache_invalidations": (int, True),
+    "post_ingest_identical": (bool, True),
+}
+
 #: every nested block bench.py may emit — THE single declaration
 #: point; _TOP, SCHEMA, validate_record and the CLI listing all
 #: derive from it (self_check() pins the derivation)
@@ -311,6 +344,7 @@ _BLOCKS = {
     "spgemm": _SPGEMM,
     "fleet": _FLEET,
     "telemetry": _TELEMETRY,
+    "autopilot": _AUTOPILOT,
 }
 
 _TOP = {**_TOP_SCALARS, **{k: (dict, False) for k in _BLOCKS}}

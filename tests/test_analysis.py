@@ -1,7 +1,7 @@
 """analysis/ — grape-lint: static contract linter + artifact auditor
 (ISSUE 8 acceptance).
 
-Pins: each AST rule R1-R8 trips on a known-bad fixture snippet and
+Pins: each AST rule R1-R9 trips on a known-bad fixture snippet and
 stays silent on the matching known-good one; the suppression baseline
 round-trips and is keyed by line-stable fingerprints; the artifact
 audits run on a REAL compiled SSSP runner (constant-bloat clean,
@@ -602,6 +602,86 @@ def test_r8_shipped_stats_surfaces_are_clean():
             src = fh.read()
         r8 = [f for f in lint_source(src, rel) if f.rule == "R8"]
         assert not r8, (owner, [f.message for f in r8])
+
+
+# ---- R9: result-cache call sites must name the full key -------------------
+
+
+def test_r9_trips_on_incomplete_lookup_key():
+    # the R3 shape on the result cache: a call site that drops a key
+    # field silently shares one cached answer across identities
+    src = """
+    def probe(cache, compat, src_id):
+        return cache.lookup(compat, src_id, 0)
+    """
+    assert "R9" in _rules(src, "libgrape_lite_tpu/serve/session.py")
+
+
+def test_r9_trips_on_store_missing_fence():
+    src = """
+    def deliver(self, compat, source, res):
+        self.result_cache.store(compat, source, res)
+    """
+    assert "R9" in _rules(src, "libgrape_lite_tpu/serve/queue.py")
+
+
+def test_r9_passes_full_positional_key():
+    src = """
+    def probe(cache, compat, source, fence):
+        return cache.lookup(compat, source, fence)
+    """
+    assert "R9" not in _rules(src, "libgrape_lite_tpu/serve/session.py")
+
+
+def test_r9_passes_keyword_and_synonym_spellings():
+    # keyword names count as naming the field; the fence may be spelt
+    # epoch/version (the session's ingest-counter idiom)
+    src = """
+    def deliver(self, ck, s, res):
+        self.result_cache.store(compat=ck, source=s,
+                                fence=self.epoch(), result=res)
+
+    def probe(self, cache, compat, source):
+        return cache.lookup(compat, source, self._ingest_epoch)
+    """
+    assert "R9" not in _rules(src, "libgrape_lite_tpu/serve/queue.py")
+
+
+def test_r9_ignores_non_cache_receivers():
+    # lookup()/store() on something that is not a result cache (a
+    # registry, a dict wrapper) is out of scope
+    src = """
+    def resolve(registry, compat, src_id):
+        return registry.lookup(compat, src_id)
+    """
+    assert "R9" not in _rules(src, "libgrape_lite_tpu/serve/session.py")
+
+
+def test_r9_exempts_the_cache_module_itself():
+    src = """
+    def _evict(self, compat, src_id):
+        self._entries.cache.lookup(compat, src_id, 0)
+    """
+    assert "R9" in _rules(src, "libgrape_lite_tpu/serve/other.py")
+    assert "R9" not in _rules(
+        src, "libgrape_lite_tpu/autopilot/cache.py")
+
+
+def test_r9_shipped_call_sites_are_clean():
+    # zero-entry baseline: the two shipped call sites (the session's
+    # submit probe, the queue's deliver store) name the full key
+    import os
+
+    import libgrape_lite_tpu
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(libgrape_lite_tpu.__file__)))
+    for rel in ("libgrape_lite_tpu/serve/session.py",
+                "libgrape_lite_tpu/serve/queue.py"):
+        with open(os.path.join(root, rel)) as fh:
+            src = fh.read()
+        r9 = [f for f in lint_source(src, rel) if f.rule == "R9"]
+        assert not r9, (rel, [f.message for f in r9])
 
 
 # ---- baseline round-trip --------------------------------------------------

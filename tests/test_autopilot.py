@@ -1,0 +1,700 @@
+"""autopilot/ — the closed observe->decide->act loop (ISSUE 16).
+
+Pins: the pure scaler decide tables (hysteresis holds a single spike,
+cooldown and the replica bounds hold, depth/wait/burn each trigger);
+the scale drill — the autoscaler grows a one-replica fleet under a
+backlog through the zero-drop machinery and every answer stays
+byte-identical to a static run, with rejoin preferred over a fresh
+replicate and the HBM budget demoting an unaffordable scale-up to a
+recorded hold; the fence-epoch result cache (hit/miss/LRU/epoch
+invalidation, failed and deferred results never cached, a repeat hit
+costs ZERO XLA compiles, post-ingest answers byte-identical to cold);
+priced admission (the pure shed/defer table, shed fails loudly with
+``reason=shed_over_budget`` AND burns the tenant's SLO budget — same
+for deadline expiry, the PR's queue bugfix); the feeder step-schedule
+parser; and the federated ``autopilot`` namespace self-check.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_dyn import ADDS, build_graph
+
+
+@pytest.fixture(autouse=True)
+def _clean_surfaces():
+    """Every test sees pristine autopilot/slo/fleet ledgers."""
+    from libgrape_lite_tpu.autopilot.signals import AUTOPILOT_STATS
+    from libgrape_lite_tpu.fleet import FLEET_STATS
+    from libgrape_lite_tpu.obs import slo
+
+    AUTOPILOT_STATS.reset()
+    FLEET_STATS.reset()
+    slo.configure(None)
+    yield
+    slo.configure(None)
+    AUTOPILOT_STATS.reset()
+    FLEET_STATS.reset()
+
+
+def _sig(depth=0, out=0, replicas=1, burn=0.0, p99=0.0, fence=0):
+    from libgrape_lite_tpu.autopilot.signals import ControlSignals
+
+    return ControlSignals(
+        queue_depth=depth, outstanding=out, wait_p50_ms=0.0,
+        wait_p99_ms=p99, max_burn=burn, burn_by_key=(),
+        replicas=replicas, total_replicas=replicas, fence=fence,
+    )
+
+
+# ---- the pure decide tables -----------------------------------------------
+
+
+def test_decide_holds_until_window_fills():
+    from libgrape_lite_tpu.autopilot.scaler import ScalerConfig, decide
+
+    cfg = ScalerConfig(window=3, up_queue_depth=2)
+    hot = _sig(depth=50)
+    assert decide([], cfg).reason == "no_signals"
+    assert decide([hot], cfg).reason == "window_filling"
+    assert decide([hot, hot], cfg).reason == "window_filling"
+    d = decide([hot, hot, hot], cfg)
+    assert d.action == "scale_up" and d.target == 2
+
+
+def test_decide_one_spike_never_flaps():
+    """Hysteresis: overload must hold across the WHOLE window."""
+    from libgrape_lite_tpu.autopilot.scaler import ScalerConfig, decide
+
+    cfg = ScalerConfig(window=3, up_queue_depth=2)
+    calm, hot = _sig(depth=0), _sig(depth=50)
+    for window in ([calm, hot, hot], [hot, calm, hot], [hot, hot, calm]):
+        assert decide(window, cfg).action == "hold"
+
+
+def test_decide_cooldown_overrides_everything():
+    from libgrape_lite_tpu.autopilot.scaler import ScalerConfig, decide
+
+    cfg = ScalerConfig(window=1, up_queue_depth=2)
+    d = decide([_sig(depth=50)], cfg, cooldown=2)
+    assert d.action == "hold" and d.reason == "cooldown"
+
+
+def test_decide_respects_replica_bounds():
+    from libgrape_lite_tpu.autopilot.scaler import ScalerConfig, decide
+
+    cfg = ScalerConfig(min_replicas=1, max_replicas=2, window=1,
+                       up_queue_depth=2)
+    hot2 = _sig(depth=50, replicas=2)
+    assert decide([hot2], cfg).reason == "at_max_replicas"
+    calm1 = _sig(depth=0, replicas=1)
+    assert decide([calm1], cfg).reason == "at_min_replicas"
+    calm2 = _sig(depth=0, replicas=2)
+    d = decide([calm2], cfg)
+    assert d.action == "scale_down" and d.target == 1
+    assert d.reason == "sustained_idle"
+
+
+def test_decide_per_replica_depth_not_total():
+    """Depth is judged PER ROUTABLE REPLICA — the same total backlog
+    that overloads one replica is in-band for four."""
+    from libgrape_lite_tpu.autopilot.scaler import ScalerConfig, decide
+
+    cfg = ScalerConfig(window=1, up_queue_depth=8, max_replicas=8)
+    assert decide([_sig(depth=20, replicas=1)], cfg).action == "scale_up"
+    assert decide([_sig(depth=20, replicas=4)], cfg).action == "hold"
+
+
+def test_decide_burn_and_wait_triggers():
+    from libgrape_lite_tpu.autopilot.scaler import ScalerConfig, decide
+
+    cfg = ScalerConfig(window=1, up_queue_depth=1000,
+                       up_burn=1.0, up_wait_p99_ms=50.0)
+    d = decide([_sig(burn=2.5)], cfg)
+    assert d.action == "scale_up" and "burn" in d.reason
+    d = decide([_sig(p99=200.0)], cfg)
+    assert d.action == "scale_up" and "p99" in d.reason
+    # outstanding work blocks the calm path even at depth 0
+    assert decide([_sig(out=3, replicas=2)], cfg).reason == "in_band"
+
+
+def test_scaler_config_validates():
+    from libgrape_lite_tpu.autopilot.scaler import ScalerConfig
+
+    with pytest.raises(ValueError, match="min_replicas"):
+        ScalerConfig(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        ScalerConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="window"):
+        ScalerConfig(window=0)
+    with pytest.raises(ValueError, match="cooldown"):
+        ScalerConfig(cooldown_ticks=-1)
+
+
+# ---- the result cache -----------------------------------------------------
+
+
+class _Res:
+    def __init__(self, ok=True, values=b"v", rounds=3,
+                 terminate_code=0, deferred=False):
+        self.ok = ok
+        self.values = values
+        self.rounds = rounds
+        self.terminate_code = terminate_code
+        self.deferred = deferred
+
+
+def test_cache_key_contract_is_published():
+    """grape-lint R9 anchors on this tuple — it IS the soundness
+    contract (compat structural identity + lane source + fence)."""
+    from libgrape_lite_tpu.autopilot.cache import CACHE_KEY_FIELDS
+
+    assert CACHE_KEY_FIELDS == ("compat", "source", "fence")
+
+
+def test_cache_hit_miss_and_counters():
+    from libgrape_lite_tpu.autopilot.cache import ResultCache
+
+    c = ResultCache(capacity=8)
+    compat = ("sssp", 64, None)
+    assert c.lookup(compat, source=0, fence=0) is None
+    assert c.store(compat, source=0, fence=0, result=_Res())
+    assert c.lookup(compat, source=0, fence=0) == (b"v", 3, 0)
+    # any key field differing is a structural miss
+    assert c.lookup(compat, source=1, fence=0) is None
+    assert c.lookup(compat, source=0, fence=1) is None
+    assert c.lookup(("bfs", 64, None), source=0, fence=0) is None
+    assert (c.hits, c.misses, c.stores) == (1, 4, 1)
+
+
+def test_cache_lru_eviction_is_counted():
+    from libgrape_lite_tpu.autopilot.cache import ResultCache
+
+    c = ResultCache(capacity=2)
+    for s in (0, 1):
+        c.store("k", source=s, fence=0, result=_Res())
+    c.lookup("k", source=0, fence=0)  # freshen 0: victim becomes 1
+    c.store("k", source=2, fence=0, result=_Res())
+    assert c.evictions == 1 and len(c) == 2
+    assert c.lookup("k", source=1, fence=0) is None
+    assert c.lookup("k", source=0, fence=0) is not None
+
+
+def test_cache_fence_invalidation_wholesale():
+    from libgrape_lite_tpu.autopilot.cache import ResultCache
+
+    c = ResultCache(capacity=8)
+    for s in range(3):
+        c.store("k", source=s, fence=0, result=_Res())
+    c.store("k", source=9, fence=1, result=_Res())
+    assert c.invalidate_stale(1) == 3
+    assert c.invalidations == 3 and len(c) == 1
+    assert c.lookup("k", source=9, fence=1) is not None
+
+
+def test_cache_never_stores_failed_deferred_or_unhashable():
+    from libgrape_lite_tpu.autopilot.cache import ResultCache
+
+    c = ResultCache(capacity=8)
+    assert not c.store("k", 0, 0, None)
+    assert not c.store("k", 0, 0, _Res(ok=False))
+    assert not c.store("k", 0, 0, _Res(deferred=True))
+    assert not c.store("k", 0, 0, _Res(values=None))
+    assert not c.store(["unhashable"], 0, 0, _Res())
+    assert c.stores == 0 and len(c) == 0
+    # an unhashable lookup key is a miss, never a raise
+    assert c.lookup(["unhashable"], 0, 0) is None
+
+
+def test_cache_capacity_validates():
+    from libgrape_lite_tpu.autopilot.cache import ResultCache
+
+    with pytest.raises(ValueError, match="capacity"):
+        ResultCache(capacity=0)
+
+
+# ---- priced admission -----------------------------------------------------
+
+
+def test_decide_admission_table():
+    from libgrape_lite_tpu.autopilot.admission import (
+        AdmissionConfig,
+        decide_admission,
+    )
+
+    cfg = AdmissionConfig(defer_burn=1.0, shed_burn=2.0, max_cost=100.0)
+    assert decide_admission(0.0, 1e9, cfg) == "admit"   # in budget:
+    assert decide_admission(0.99, 1e9, cfg) == "admit"  # never cost-gated
+    assert decide_admission(1.0, 50.0, cfg) == "defer"
+    assert decide_admission(1.5, 101.0, cfg) == "shed"  # over budget AND big
+    assert decide_admission(2.0, 0.0, cfg) == "shed"
+    no_ceiling = AdmissionConfig()
+    assert decide_admission(1.5, 1e12, no_ceiling) == "defer"
+
+
+def test_admission_config_validates():
+    from libgrape_lite_tpu.autopilot.admission import AdmissionConfig
+
+    with pytest.raises(ValueError, match="defer_burn"):
+        AdmissionConfig(defer_burn=0.0)
+    with pytest.raises(ValueError, match="shed_burn"):
+        AdmissionConfig(defer_burn=2.0, shed_burn=1.0)
+
+
+def test_query_cost_positive_and_scales_with_rounds():
+    from libgrape_lite_tpu.autopilot.admission import (
+        DEFAULT_PRICED_ROUNDS,
+        query_cost,
+    )
+
+    frag = build_graph(1)
+    c8 = query_cost(frag, max_rounds=8)
+    c16 = query_cost(frag, max_rounds=16)
+    assert c8 > 0 and c16 == pytest.approx(2 * c8)
+    assert query_cost(frag) == pytest.approx(
+        query_cost(frag, DEFAULT_PRICED_ROUNDS))
+
+
+def test_shed_fails_loudly_and_burns_the_tenant(graph_cache):
+    """An over-budget tenant's request sheds: a failed ServeResult
+    with reason=shed_over_budget returned through drain (never a
+    silent drop), and the shed itself burns the tenant's SLO budget —
+    the same accounting rule as deadline expiry."""
+    from libgrape_lite_tpu.autopilot.admission import AdmissionController
+    from libgrape_lite_tpu.obs import slo
+    from libgrape_lite_tpu.obs.slo import SLO_STATS
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    slo.configure("tenant:hog=0.000001")
+    # one failed observation blows the budget (burn >> shed_burn)
+    slo.observe("sssp", "hog", 0.001, ok=False)
+    burn0 = SLO_STATS["burn_by_key"]["tenant:hog"]
+    assert burn0 >= 2.0
+
+    sess = ServeSession(build_graph(2), policy=BatchPolicy(max_batch=4))
+    ctl = AdmissionController(cost_of=lambda req: 0.0)
+    sess.queue.admission = ctl.review
+    doomed = sess.submit("sssp", {"source": 0}, tenant="hog")
+    live = sess.submit("sssp", {"source": 7})
+    out = sess.drain()
+    assert len(out) == 2
+    assert doomed.done and not doomed.result.ok
+    assert doomed.result.error["reason"] == "shed_over_budget"
+    assert sess.queue.shed == 1
+    assert live.done and live.result.ok
+    # the shed burned the tenant further — breaches grew
+    assert SLO_STATS["burn_by_key"]["tenant:hog"] >= burn0
+    assert SLO_STATS["breaches"] >= 2
+
+
+def test_defer_queues_behind_in_budget_tenants():
+    """A deferred tenant only heads a batch when nothing in-budget is
+    pending — and an all-deferred queue still drains (no starvation)."""
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    sess = ServeSession(build_graph(2),
+                        policy=BatchPolicy(max_batch=1, max_wait_s=60.0))
+    sess.queue.admission = (
+        lambda req: "defer" if req.tenant == "slow" else "admit"
+    )
+    first = sess.queue.submit("sssp", {"source": 0}, tenant="slow")
+    second = sess.queue.submit("sssp", {"source": 7}, tenant="fast")
+    b1 = sess.queue._pop_ready(force=True)
+    assert [r.id for r in b1] == [second.id], (
+        "in-budget tenant must dispatch before the deferred one")
+    b2 = sess.queue._pop_ready(force=True)
+    assert [r.id for r in b2] == [first.id], (
+        "all-deferred queue must still drain")
+
+
+def test_deadline_expiry_burns_the_slo_budget():
+    """PR 16 queue bugfix regression: a deadline_expired failure flows
+    through slo.observe like any delivered query — before the fix the
+    tenant that caused a deadline storm never paid for it."""
+    from libgrape_lite_tpu.obs import slo
+    from libgrape_lite_tpu.obs.slo import SLO_STATS
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    slo.configure("sssp=1000")
+    sess = ServeSession(build_graph(2),
+                        policy=BatchPolicy(max_batch=8, max_wait_s=60.0))
+    doomed = sess.submit("sssp", {"source": 0}, deadline_s=0.001)
+    time.sleep(0.01)
+    out = sess.drain()
+    assert doomed.done and not doomed.result.ok
+    assert doomed.result.error["reason"] == "deadline_expired"
+    assert any(r.request_id == doomed.id for r in out)
+    assert SLO_STATS["breaches"] >= 1
+    assert SLO_STATS["burn_by_key"]["sssp"] > 0
+
+
+# ---- signals + federation -------------------------------------------------
+
+
+def test_signal_reader_never_raises_without_a_fleet():
+    from libgrape_lite_tpu.autopilot.signals import SignalReader
+
+    rd = SignalReader(window=2)
+    s1 = rd.read()
+    assert s1.replicas == 0 and s1.queue_depth == 0
+    assert not rd.saturated
+    rd.read()
+    assert rd.saturated and len(rd.recent) == 2
+    assert rd.recent[0] is s1  # oldest-first
+    rd.clear()
+    assert rd.recent == ()
+
+
+def test_autopilot_namespace_federates():
+    from libgrape_lite_tpu.autopilot import signals  # noqa: F401
+    from libgrape_lite_tpu.autopilot.signals import record_decision
+    from libgrape_lite_tpu.obs import federation
+
+    assert federation.EXPECTED["autopilot"] == (
+        "libgrape_lite_tpu.autopilot.signals")
+    assert federation.self_check() == []
+    record_decision("scale_up", reason="test", replicas=1, target=2)
+    record_decision("shed", tenant="t0")
+    snap = federation.snapshot("autopilot")
+    assert snap["scale_ups"] == 1 and snap["shed"] == 1
+    assert snap["decisions"][-1]["kind"] == "shed"
+
+
+def test_decision_log_is_bounded():
+    from libgrape_lite_tpu.autopilot.signals import (
+        AUTOPILOT_STATS,
+        MAX_DECISIONS,
+        record_decision,
+    )
+
+    for i in range(MAX_DECISIONS + 10):
+        record_decision("hold", i=i)
+    assert len(AUTOPILOT_STATS["decisions"]) <= MAX_DECISIONS
+    assert AUTOPILOT_STATS["decisions"][-1]["i"] == MAX_DECISIONS + 9
+
+
+# ---- the feeder step schedule ---------------------------------------------
+
+
+def test_parse_rate_spec_forms():
+    from libgrape_lite_tpu.serve.feeder import parse_rate_spec
+
+    assert parse_rate_spec(50) == (50.0, [])
+    assert parse_rate_spec("50") == (50.0, [])
+    assert parse_rate_spec("50:2x@100") == (50.0, [(100, 2.0)])
+    assert parse_rate_spec("50:2x@100:0.5x@300") == (
+        50.0, [(100, 2.0), (300, 0.5)])
+
+
+@pytest.mark.parametrize("bad", [
+    "0", "-5", "50:2y@100", "50:2x@", "50:x@100",
+    "50:0x@100", "50:2x@100:3x@100", "50:2x@0",
+])
+def test_parse_rate_spec_rejects_malformed(bad):
+    from libgrape_lite_tpu.serve.feeder import parse_rate_spec
+
+    with pytest.raises(ValueError):
+        parse_rate_spec(bad)
+
+
+def test_arrival_offsets_apply_steps_cumulatively():
+    from libgrape_lite_tpu.serve.feeder import arrival_offsets
+
+    # 1 qps, doubled at arrival 2: gaps 1.0, 1.0, then 0.5
+    assert arrival_offsets(4, 1.0, [(2, 2.0)]) == pytest.approx(
+        [0.0, 1.0, 2.0, 2.5])
+    # two steps compound: 2x then another 2x -> gap 0.25
+    assert arrival_offsets(5, 1.0, [(2, 2.0), (3, 2.0)]) == pytest.approx(
+        [0.0, 1.0, 2.0, 2.5, 2.75])
+
+
+def test_feeder_carries_step_schedule():
+    from libgrape_lite_tpu.serve.feeder import ArrivalFeeder
+
+    f = ArrivalFeeder(lambda *a, **k: None, [], "40:2x@10")
+    assert f.rate_qps == 40.0 and f.rate_steps == [(10, 2.0)]
+    with pytest.raises(ValueError):
+        ArrivalFeeder(lambda *a, **k: None, [], "0")
+
+
+# ---- the autoscaler against a real fleet ----------------------------------
+
+
+def _fleet(R, *, max_batch=4):
+    from libgrape_lite_tpu.dyn import RepackPolicy
+    from libgrape_lite_tpu.fleet import FleetRouter
+    from libgrape_lite_tpu.fragment.mutation import replicate_fragment
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    base = build_graph(2)
+    frags = [base] + [replicate_fragment(base) for _ in range(R - 1)]
+    sessions = [
+        ServeSession(f, policy=BatchPolicy(max_batch=max_batch),
+                     dyn=RepackPolicy(threshold=0.5, capacity=64))
+        for f in frags
+    ]
+    return FleetRouter(sessions)
+
+
+def _factory(max_batch=4):
+    from libgrape_lite_tpu.dyn import RepackPolicy
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    return lambda frag: ServeSession(
+        frag, policy=BatchPolicy(max_batch=max_batch),
+        dyn=RepackPolicy(threshold=0.5, capacity=64),
+    )
+
+
+def test_autoscaler_grows_fleet_byte_identically(graph_cache):
+    """The closed-loop drill: a backlog trips the depth trigger, the
+    autoscaler replicates a second replica mid-stream, nothing drops,
+    and every answer is byte-identical to a static R=1 run."""
+    from libgrape_lite_tpu.autopilot.scaler import Autoscaler, ScalerConfig
+    from libgrape_lite_tpu.autopilot.signals import AUTOPILOT_STATS
+
+    sources = [0, 7, 19, 30, 3, 11, 23, 29]
+    ref = _fleet(1)
+    ref_vals = {}
+    for s in sources:
+        res = ref.submit("sssp", {"source": s})
+        ref.drain()
+        ref_vals[s] = res.result.values.tobytes()
+
+    router = _fleet(1, max_batch=2)
+    scaler = Autoscaler(
+        router,
+        ScalerConfig(min_replicas=1, max_replicas=2, window=2,
+                     cooldown_ticks=2, up_queue_depth=2),
+        session_factory=_factory(max_batch=2),
+    )
+    reqs = [router.submit("sssp", {"source": s}) for s in sources]
+    # two reads over the standing backlog fill the hysteresis window
+    # before any pump drains it — the second tick must act
+    assert scaler.tick().reason == "window_filling"
+    d = scaler.tick()
+    assert d.action == "scale_up", d
+    router.drain()
+    assert AUTOPILOT_STATS["scale_ups"] >= 1
+    assert sum(1 for r in router.replicas if r.routable) == 2
+    assert all(q.result is not None and q.result.ok for q in reqs), (
+        "zero drops: every admitted query must complete")
+    for q, s in zip(reqs, sources):
+        assert q.result.values.tobytes() == ref_vals[s], (
+            "scale-up changed an answer", s)
+
+
+@pytest.mark.parametrize("R", [2, 3])
+def test_scale_drill_grow_and_shrink_byte_identity(R, graph_cache):
+    """R in {1,2,3}: grow 1 -> R replica-by-replica, serve, shrink
+    back to 1 — every answer along the trajectory byte-identical to
+    the static R=1 reference (replicated fragments are deterministic
+    rebuilds; drain is zero-drop)."""
+    from libgrape_lite_tpu.autopilot.scaler import (
+        Autoscaler,
+        Decision,
+        ScalerConfig,
+    )
+
+    sources = [0, 7, 19, 30]
+    ref = _fleet(1)
+    ref_vals = {}
+    for s in sources:
+        res = ref.submit("sssp", {"source": s})
+        ref.drain()
+        ref_vals[s] = res.result.values.tobytes()
+
+    router = _fleet(1)
+    scaler = Autoscaler(
+        router, ScalerConfig(min_replicas=1, max_replicas=R,
+                             cooldown_ticks=0),
+        session_factory=_factory(),
+    )
+    for n in range(1, R):
+        d = scaler.act(Decision("scale_up", "drill", n, n + 1))
+        assert d.action == "scale_up", d
+    assert sum(1 for r in router.replicas if r.routable) == R
+    grown = [router.submit("sssp", {"source": s}) for s in sources]
+    router.drain()
+    for q, s in zip(grown, sources):
+        assert q.result.ok
+        assert q.result.values.tobytes() == ref_vals[s], ("grown", R, s)
+    # shrink back to 1 (LIFO drains), answers still identical
+    for n in range(R, 1, -1):
+        d = scaler.act(Decision("scale_down", "drill", n, n - 1))
+        assert d.action == "scale_down", d
+        router.pump()
+    assert sum(1 for r in router.replicas if r.routable) == 1
+    shrunk = [router.submit("sssp", {"source": s}) for s in sources]
+    router.drain()
+    for q, s in zip(shrunk, sources):
+        assert q.result.ok
+        assert q.result.values.tobytes() == ref_vals[s], ("shrunk", R, s)
+
+
+def test_autoscaler_prefers_rejoin_over_replicate(graph_cache):
+    from libgrape_lite_tpu.autopilot.scaler import (
+        Autoscaler,
+        Decision,
+        ScalerConfig,
+    )
+
+    router = _fleet(2)
+    router.begin_drain(1)
+    router.pump()
+    assert not router.replicas[1].routable
+
+    def _boom(frag):
+        raise AssertionError("must rejoin the parked replica, "
+                             "not replicate a new one")
+
+    scaler = Autoscaler(router, ScalerConfig(max_replicas=2),
+                        session_factory=_boom)
+    d = scaler.act(Decision("scale_up", "drill", 1, 2))
+    assert d.action == "scale_up" and "rejoined r1" in d.reason
+    assert router.replicas[1].routable
+    assert scaler.cooldown == scaler.config.cooldown_ticks
+
+
+def test_autoscaler_budget_demotes_to_hold(graph_cache):
+    from libgrape_lite_tpu.autopilot.scaler import (
+        Autoscaler,
+        Decision,
+        ScalerConfig,
+    )
+    from libgrape_lite_tpu.fleet import FleetBudget
+
+    router = _fleet(1)
+    scaler = Autoscaler(
+        router, ScalerConfig(max_replicas=2),
+        session_factory=_factory(),
+        budget=FleetBudget(capacity_bytes=1),
+    )
+    d = scaler.act(Decision("scale_up", "drill", 1, 2))
+    assert d.action == "hold" and d.reason.startswith("hbm_budget")
+    assert len(router.replicas) == 1
+
+
+def test_autoscaler_scales_down_lifo_without_rejoin(graph_cache):
+    from libgrape_lite_tpu.autopilot.scaler import Autoscaler, ScalerConfig
+
+    router = _fleet(2)
+    scaler = Autoscaler(
+        router,
+        ScalerConfig(min_replicas=1, max_replicas=2, window=2,
+                     cooldown_ticks=0),
+    )
+    decisions = [scaler.tick() for _ in range(3)]
+    router.pump()
+    assert any(d.action == "scale_down" for d in decisions)
+    assert router.replicas[0].routable
+    assert not router.replicas[1].routable  # highest index drains
+    # parked, not rejoined: the next scale-up gets the cheap path
+    assert len(router.replicas) == 2
+
+
+def test_autoscaler_without_factory_holds(graph_cache):
+    from libgrape_lite_tpu.autopilot.scaler import (
+        Autoscaler,
+        Decision,
+        ScalerConfig,
+    )
+
+    router = _fleet(1)
+    scaler = Autoscaler(router, ScalerConfig(max_replicas=2))
+    d = scaler.act(Decision("scale_up", "drill", 1, 2))
+    assert d.action == "hold" and d.reason == "no_session_factory"
+
+
+# ---- cache x serving: zero-compile hits, epoch soundness ------------------
+
+
+def test_cache_hit_is_zero_compile_and_byte_identical(graph_cache):
+    from libgrape_lite_tpu.analysis.artifact import compile_events
+    from libgrape_lite_tpu.autopilot.cache import ResultCache
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    sess = ServeSession(build_graph(2), policy=BatchPolicy(max_batch=4))
+    cache = ResultCache(capacity=8)
+    sess.attach_result_cache(cache)
+    cold = sess.serve([("sssp", {"source": 0})])
+    assert cold[0].ok and cache.stores == 1
+    with compile_events() as ev:
+        hot = sess.serve([("sssp", {"source": 0})])
+    assert ev.compiles == 0, ("a cache hit must touch no device",
+                              ev.events)
+    assert cache.hits == 1
+    assert hot[0].ok
+    assert np.asarray(hot[0].values).tobytes() == (
+        np.asarray(cold[0].values).tobytes())
+
+
+def test_router_ingest_fence_invalidates_cache(graph_cache):
+    """The epoch soundness drill: entries die wholesale at the fence
+    bump, and the post-ingest recompute is byte-identical to a cold
+    session that applied the same deltas."""
+    from libgrape_lite_tpu.autopilot.cache import ResultCache
+    from libgrape_lite_tpu.dyn import RepackPolicy
+    from libgrape_lite_tpu.fragment.mutation import replicate_fragment
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    base = build_graph(2)
+    cold_frag = replicate_fragment(base)
+    router = _fleet_of(base)
+    cache = ResultCache(capacity=8)
+    router.attach_cache(cache)
+
+    r1 = router.submit("sssp", {"source": 0})
+    router.drain()
+    assert r1.result.ok and cache.stores == 1
+    r2 = router.submit("sssp", {"source": 0})
+    router.drain()
+    assert cache.hits == 1 and r2.result.ok
+
+    fence0 = router.fence
+    router.ingest(ADDS)
+    assert router.fence == fence0 + 1
+    assert cache.invalidations >= 1 and len(cache) == 0
+
+    r3 = router.submit("sssp", {"source": 0})
+    router.drain()
+    assert r3.result.ok
+
+    cold = ServeSession(cold_frag, policy=BatchPolicy(max_batch=4),
+                        dyn=RepackPolicy(threshold=0.5, capacity=64))
+    cold.ingest(ADDS)
+    ref = cold.serve([("sssp", {"source": 0})])
+    assert r3.result.values.tobytes() == ref[0].values.tobytes(), (
+        "post-ingest answer must match a cold post-delta session")
+
+
+def _fleet_of(frag):
+    from libgrape_lite_tpu.dyn import RepackPolicy
+    from libgrape_lite_tpu.fleet import FleetRouter
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    return FleetRouter([
+        ServeSession(frag, policy=BatchPolicy(max_batch=4),
+                     dyn=RepackPolicy(threshold=0.5, capacity=64)),
+    ])
+
+
+def test_bare_session_ingest_bumps_cache_epoch(graph_cache):
+    """Without a fleet the session's own ingest counter is the fence:
+    a content-changing ingest structurally misses every old entry."""
+    from libgrape_lite_tpu.autopilot.cache import ResultCache
+    from libgrape_lite_tpu.dyn import RepackPolicy
+    from libgrape_lite_tpu.serve import BatchPolicy, ServeSession
+
+    sess = ServeSession(build_graph(2), policy=BatchPolicy(max_batch=4),
+                        dyn=RepackPolicy(threshold=0.5, capacity=64))
+    cache = ResultCache(capacity=8)
+    sess.attach_result_cache(cache)
+    sess.serve([("sssp", {"source": 0})])
+    assert cache.stores == 1
+    sess.ingest(ADDS)
+    assert len(cache) == 0, "ingest must invalidate the stale epoch"
+    out = sess.serve([("sssp", {"source": 0})])
+    assert out[0].ok and cache.hits == 0
