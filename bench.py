@@ -147,6 +147,15 @@ the ledger-auto decision at lane geometry.  Env knobs:
 GRAPE_BENCH_NO_SPGEMM=1 skips, GRAPE_BENCH_SPGEMM_SCALE sizes the
 executed A/B.
 
+The `calibration` lane (r17, ops/calibration.py, docs/CALIBRATION.md)
+re-prices a measured sample set under the ACTIVE RateProfile and
+exits 2 when an explicitly installed GRAPE_RATE_PROFILE has drifted
+more than 5% from measurement on any priced surface; it also reports
+a fresh fit (rates, RMS residual, fallback notes) for the
+pinned-vs-fitted PERF_NOTES table.  Env knobs:
+GRAPE_BENCH_NO_CALIBRATION=1 skips, GRAPE_CALIBRATION_SAMPLES points
+at a recorded sweep (deterministic in CI) instead of re-measuring.
+
 Baseline derivation (BASELINE.md): the reference GPU backend runs
 PageRank on soc-LiveJournal1 (68.99M directed edges) in 24.65 ms on
 8× V100 (`Performance.md:94`), i.e. 68.99e6 * 10 rounds / 0.02465 s
@@ -2169,6 +2178,77 @@ def main():
                 file=sys.stderr,
             )
 
+    # calibration lane (r17, ops/calibration.py, docs/CALIBRATION.md):
+    # the drift gate — recompute the ACTIVE profile's modeled walls
+    # over a measured sample set and fail the bench when an explicit
+    # GRAPE_RATE_PROFILE has drifted >5% from measurement on any
+    # priced surface.  Samples come from GRAPE_CALIBRATION_SAMPLES
+    # (the recorded sweep a `calibrate` run persisted — deterministic
+    # in CI) or a fresh small-geometry sweep.  The pinned default is
+    # NOT gated off-hardware: CPU walls are not v5e walls by
+    # construction, only a profile somebody explicitly installed
+    # claims to model THIS backend.  A fresh fit is also reported
+    # (rates + residual + fallback notes) so PERF_NOTES can table
+    # pinned-vs-fitted.  GRAPE_BENCH_NO_CALIBRATION=1 skips.
+    calibration_mismatch = None
+    if not os.environ.get("GRAPE_BENCH_NO_CALIBRATION"):
+        try:
+            from libgrape_lite_tpu.ops import calibration as calib
+
+            spath = os.environ.get("GRAPE_CALIBRATION_SAMPLES")
+            if spath:
+                samples = calib.load_samples(spath)
+            else:
+                samples = calib.microbench_samples(
+                    scales=(8, 9, 10), repeats=2)
+                floor = calib.default_min_wall_s()
+                samples = [s for s in samples if s["wall_s"] >= floor]
+            prof = calib.active_profile()
+            rep = calib.drift_report(prof, samples)
+            try:
+                fit, notes = calib.fit_rates_auto(
+                    samples, base=prof, name="bench-fit")
+                fitted_prof = fit.profile
+                residual_pct = round(fit.residual * 100.0, 3)
+            except calib.CalibrationError as e:
+                fitted_prof = prof
+                notes = [f"fit failed: {e}"]
+                residual_pct = -1.0
+            record["calibration"] = {
+                "profile": prof.label(),
+                "fingerprint": calib.backend_fingerprint(),
+                "source": prof.source,
+                "fitted": bool(prof.fitted),
+                "samples": len(samples),
+                "residual_pct": residual_pct,
+                "drift_pct": rep["drift_pct"],
+                "max_sample_drift_pct": rep["max_sample_drift_pct"],
+                "drift_ok": rep["drift_ok"],
+                "rates": {
+                    "clock_hz": fitted_prof.clock_hz,
+                    "vpu_lanes_per_cycle":
+                        fitted_prof.vpu_lanes_per_cycle,
+                    "mxu_cyc_per_elem": fitted_prof.mxu_cyc_per_elem,
+                    "hbm_bps": fitted_prof.hbm_bps,
+                    "gather_rows_per_cycle":
+                        fitted_prof.gather_rows_per_cycle,
+                    "dispatch_overhead_s":
+                        fitted_prof.dispatch_overhead_s,
+                },
+                "unfitted": sorted(fitted_prof.unfitted),
+                "fallback_notes": notes,
+                "surfaces": rep["surfaces"],
+            }
+            _emit_record(record)
+            if os.environ.get(calib.PROFILE_ENV) and not rep["drift_ok"]:
+                calibration_mismatch = rep["drift_pct"]
+        except Exception as e:  # the lane must not cost the bench
+            print(
+                f"[bench] calibration lane failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+
     if os.environ.get("GRAPE_BENCH_FULL"):
         # side metrics on stderr AFTER the primary line is out — a hang
         # or failure here must not cost the already-made measurement
@@ -2265,6 +2345,15 @@ def main():
         print(
             f"[bench] FATAL: autopilot lane verdict failed: "
             f"{autopilot_mismatch} — see the autopilot block above",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    if calibration_mismatch is not None:
+        print(
+            f"[bench] FATAL: the installed GRAPE_RATE_PROFILE drifts "
+            f"{calibration_mismatch:.1f}% (> 5%) from measured device "
+            "walls — recalibrate (python -m libgrape_lite_tpu.cli "
+            "calibrate) or unset the stale profile",
             file=sys.stderr,
         )
         sys.exit(2)

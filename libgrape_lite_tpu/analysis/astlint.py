@@ -1147,13 +1147,77 @@ def _check_r9(module: _Scope, path: str,
             ))
 
 
+#: module-level names that declare a pricing RATE.  Op-count
+#: conventions (_ITEM_VPU, DEFAULT_OPS_PER_EDGE, stage heights) are
+#: NOT rates — they must stay literal so the recount gates remain
+#: independent of the planners they audit.
+_R10_NAME_RE = re.compile(
+    r"(_BPS|_HZ|_CYC_PER_ELEM|_PER_CYCLE|_ROWS_PER_CYCLE)$"
+    r"|^_?GATHER_RATES$"
+)
+
+
+def _r10_literal_number(value: ast.AST) -> bool:
+    """True when `value` is (or contains, for dict tables) a numeric
+    literal — a profile-attribute read (`default_profile().hbm_bps`)
+    is the sanctioned form and has no literal to flag."""
+    if isinstance(value, ast.Constant):
+        return isinstance(value.value, (int, float)) and not isinstance(
+            value.value, bool
+        )
+    if isinstance(value, ast.BinOp):
+        return (_r10_literal_number(value.left)
+                and _r10_literal_number(value.right))
+    if isinstance(value, ast.UnaryOp):
+        return _r10_literal_number(value.operand)
+    if isinstance(value, ast.Dict):
+        return any(_r10_literal_number(v) for v in value.values)
+    return False
+
+
+def _check_r10(module: _Scope, path: str,
+               findings: List[Finding]) -> None:
+    """R10 pinned-rate-constant.  A module-level assignment whose name
+    declares a pricing rate (``*_BPS``, ``*_HZ``, ``*_CYC_PER_ELEM``,
+    ``*_PER_CYCLE``, a ``GATHER_RATES`` table) bound to a numeric
+    LITERAL outside ops/calibration.py is a private rate copy: the
+    calibration pass cannot fit it and the drift gate cannot see it.
+    Reading the shared profile (``default_profile().hbm_bps``) passes
+    — the name then tracks THE rate, pinned or fitted."""
+    if path.endswith("ops/calibration.py"):
+        return
+    for stmt in module.node.body:
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        names = [
+            t.id for t in targets
+            if isinstance(t, ast.Name) and _R10_NAME_RE.search(t.id)
+        ]
+        if not names or not _r10_literal_number(value):
+            continue
+        for name in names:
+            findings.append(Finding(
+                "R10", path, stmt.lineno, name,
+                f"pricing rate {name} is pinned as a numeric literal "
+                "outside ops/calibration.py — a private copy the "
+                "calibration fit cannot update and the drift gate "
+                "cannot audit; read it from the shared RateProfile "
+                "(ops/calibration.default_profile / active_profile) "
+                "instead",
+            ))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
 
 def lint_source(src: str, relpath: str) -> List[Finding]:
-    """All R1-R9 findings for one module's source text."""
+    """All R1-R10 findings for one module's source text."""
     relpath = relpath.replace(os.sep, "/")
     try:
         tree = ast.parse(src)
@@ -1179,6 +1243,7 @@ def lint_source(src: str, relpath: str) -> List[Finding]:
     _check_r7(module, relpath, findings)
     _check_r8(module, relpath, findings)
     _check_r9(module, relpath, findings)
+    _check_r10(module, relpath, findings)
     return findings
 
 

@@ -143,6 +143,22 @@ RULES: Dict[str, Rule] = {
             "result cache before it can recur (zero-entry baseline)",
         ),
         Rule(
+            "R10", "pinned-rate-constant",
+            "a module-level float-literal pricing RATE (a *_BPS / "
+            "*_HZ / *_CYC_PER_ELEM / *_PER_CYCLE constant or a "
+            "GATHER_RATES table) is defined outside "
+            "ops/calibration.py — a private rate copy that the "
+            "calibration pass cannot fit and the drift gate cannot "
+            "see, so the surface it prices silently diverges from "
+            "measured truth",
+            "PR 17: _MXU_CYC_PER_ELEM = 0.008 lived in BOTH "
+            "ops/spgemm_pack.py and scripts/pack_cost_model.py, and "
+            "pipeline/partition carried their own VPU/ICI copies — "
+            "five pricing surfaces, four rate tables, none of them "
+            "fittable; collapsed onto the RateProfile (zero-entry "
+            "baseline over the migrated tree)",
+        ),
+        Rule(
             "A1", "constant-bloat",
             "the lowered HLO of a fused runner holds a literal "
             "constant above the byte threshold — an R1 escape "

@@ -79,6 +79,37 @@ def query_cost(fragment, max_rounds: Optional[int] = None) -> float:
     return per_round * rounds
 
 
+def query_wall_s(fragment, max_rounds: Optional[int] = None,
+                 profile=None) -> float:
+    """Estimated WALL seconds of one point query on `fragment` under
+    `profile` (default: the active RateProfile) — the widest resolved
+    pack plan's full ledger columns priced through the profile's
+    additive wall model, times the round limit.  0.0 when no plan has
+    been resolved yet (the byte fallback has no op columns to price);
+    byte-based `query_cost` stays the load-shaped metric, this is the
+    latency-shaped one a fitted profile keeps honest."""
+    from libgrape_lite_tpu.ops.calibration import active_profile
+
+    p = profile or active_profile()
+    rounds = int(max_rounds) if max_rounds else DEFAULT_PRICED_ROUNDS
+    best = 0.0
+    try:
+        from libgrape_lite_tpu.ops.spmv_pack import (
+            _frag_cache,
+            plan_ledger,
+        )
+
+        for plan in _frag_cache(fragment).values():
+            try:
+                totals = plan_ledger(plan)["totals"]
+            except Exception:
+                continue
+            best = max(best, p.wall_s(totals))
+    except Exception:
+        return 0.0
+    return best * rounds
+
+
 @dataclass(frozen=True)
 class AdmissionConfig:
     """Thresholds of the shed/defer policy (docs/AUTOPILOT.md)."""
@@ -91,6 +122,10 @@ class AdmissionConfig:
     # tenant's request pricier than this sheds instead of deferring —
     # in-budget tenants are never cost-gated (None disables)
     max_cost: Optional[float] = None
+    # optional absolute WALL ceiling (seconds/query, priced from the
+    # active RateProfile via `query_wall_s`): same over-budget-only
+    # semantics as max_cost (None disables — the shipped default)
+    max_cost_s: Optional[float] = None
 
     def __post_init__(self):
         if self.defer_burn <= 0:
@@ -105,14 +140,18 @@ class AdmissionConfig:
 
 
 def decide_admission(burn: float, cost: float,
-                     cfg: AdmissionConfig) -> str:
+                     cfg: AdmissionConfig,
+                     cost_s: float = 0.0) -> str:
     """Pure decide: 'admit' | 'defer' | 'shed' for one request of a
-    tenant burning `burn` with priced cost `cost`."""
+    tenant burning `burn` with priced cost `cost` (HBM bytes) and
+    modeled wall `cost_s` (seconds, 0.0 = unpriced)."""
     if burn < cfg.defer_burn:
         return "admit"
     if burn >= cfg.shed_burn:
         return "shed"
     if cfg.max_cost is not None and cost > cfg.max_cost:
+        return "shed"
+    if cfg.max_cost_s is not None and cost_s > cfg.max_cost_s:
         return "shed"
     return "defer"
 
@@ -151,20 +190,32 @@ class AdmissionController:
             return 0.0
         return query_cost(self._fragment, req.max_rounds)
 
+    def wall_of(self, req, profile) -> float:
+        if self._cost_of is not None or self._fragment is None:
+            return 0.0
+        return query_wall_s(self._fragment, req.max_rounds,
+                            profile=profile)
+
     def review(self, req) -> str:
         """'admit' | 'defer' | 'shed' for one queued request.  Records
         shed/defer decisions (admits are the steady state and only
         counted implicitly); never raises — an admission failure must
         not wedge the queue head."""
+        from libgrape_lite_tpu.ops.calibration import active_profile
+
         try:
+            prof = active_profile()
             burn = self.burn_of(req.tenant)
             cost = self.cost_of(req)
-            verdict = decide_admission(burn, cost, self.config)
+            cost_s = self.wall_of(req, prof)
+            verdict = decide_admission(burn, cost, self.config,
+                                       cost_s=cost_s)
         except Exception:
             return "admit"
         if verdict != "admit":
             record_decision(
                 verdict, tenant=req.tenant or "", app=req.app_key,
                 burn=round(burn, 4), cost=round(cost, 1),
+                cost_s=round(cost_s, 6), profile=prof.label(),
             )
         return verdict

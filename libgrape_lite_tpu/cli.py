@@ -20,6 +20,14 @@ over the library tree (or explicit paths), optionally the
 compiled-artifact audits (--artifact), against the suppression
 baseline — exits nonzero on any unsuppressed finding.
 
+`python -m libgrape_lite_tpu.cli calibrate ...` runs the pricing-rate
+calibration pass (ops/calibration.py, docs/CALIBRATION.md): a seeded
+micro-bench sweep of the pack SpMV / masked-SpGEMM dispatches, a
+least-squares rate fit over the measured walls, profile + sample
+persistence, and the 5% modeled-vs-measured drift gate (`--check`
+re-gates the active GRAPE_RATE_PROFILE without refitting; exit 2 on
+drift).
+
 `python -m libgrape_lite_tpu.cli postmortem <bundle.json>` renders a
 flight-recorder bundle (obs/recorder.py; dumped into the
 GRAPE_POSTMORTEM sink on a guard breach, fence violation or deadline
@@ -329,6 +337,160 @@ def lint_main(argv=None) -> int:
         quiet = [f for f in report["findings"] if f["suppressed"]]
         print(analysis.render_text(live, quiet, report.get("stale")))
     return rc
+
+
+def make_calibrate_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="libgrape_lite_tpu calibrate")
+    p.add_argument("--out", default="",
+                   help="write the fitted RateProfile json here "
+                        "(install it via GRAPE_RATE_PROFILE=<path>)")
+    p.add_argument("--samples-out", default="",
+                   help="persist the measured sweep json — the bench "
+                        "calibration lane and --check replay it "
+                        "deterministically (GRAPE_CALIBRATION_SAMPLES)")
+    p.add_argument("--samples", default="",
+                   help="fit/check from a RECORDED sample set instead "
+                        "of re-measuring")
+    p.add_argument("--check", action="store_true",
+                   help="no fit: drift-gate the ACTIVE profile "
+                        "(GRAPE_RATE_PROFILE, or --profile) against "
+                        "the samples; exit 2 beyond the 5%% tolerance")
+    p.add_argument("--profile", default="",
+                   help="explicit profile json for --check (default: "
+                        "the active profile)")
+    p.add_argument("--scales", default="8,9,10",
+                   help="comma-separated RMAT scales for the sweep")
+    p.add_argument("--ef", type=int, default=8,
+                   help="sweep edge factor")
+    p.add_argument("--repeats", type=int, default=3,
+                   help="best-of-N walls per dispatch")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--min-wall-s", type=float, default=-1.0,
+                   help="exclude sweep samples with walls under this "
+                        "(default: backend-appropriate — 20ms on the "
+                        "CPU backend where sub-noise-floor walls are "
+                        "scheduler jitter, 0 on real accelerators)")
+    p.add_argument("--json", action="store_true",
+                   help="print one structured record instead of the "
+                        "table")
+    p.add_argument("--platform", default="",
+                   help="jax platform override (e.g. cpu)")
+    return p
+
+
+def calibrate_main(argv=None) -> int:
+    """The `calibrate` subcommand (ops/calibration.py,
+    docs/CALIBRATION.md): measure device walls, fit the pricing-rate
+    profile, persist it, and drift-gate modeled-vs-measured.  Exit 0 =
+    fit ok / gate passed, 2 = infeasible fit or the drift gate
+    tripped."""
+    import json as _json
+    import sys
+
+    ns = make_calibrate_parser().parse_args(argv)
+    _apply_platform(ns.platform, 0)
+
+    from libgrape_lite_tpu.ops import calibration as calib
+
+    try:
+        if ns.samples:
+            samples = calib.load_samples(ns.samples)
+        else:
+            scales = tuple(int(s) for s in ns.scales.split(",") if s)
+            samples = calib.microbench_samples(
+                scales=scales, ef=ns.ef, seed=ns.seed,
+                repeats=ns.repeats,
+            )
+            floor = (ns.min_wall_s if ns.min_wall_s >= 0
+                     else calib.default_min_wall_s())
+            kept = [s for s in samples if s["wall_s"] >= floor]
+            if len(kept) < len(samples):
+                print(
+                    f"calibrate: dropped {len(samples) - len(kept)} "
+                    f"sample(s) under the {floor * 1e3:.0f}ms noise "
+                    "floor",
+                    file=sys.stderr,
+                )
+            samples = kept
+        if not samples:
+            print("calibrate: no usable samples measured — nothing "
+                  "to fit", file=sys.stderr)
+            return 2
+
+        notes: list = []
+        fit = None
+        if ns.check:
+            prof = (calib.load_profile(ns.profile) if ns.profile
+                    else calib.active_profile())
+        else:
+            fit, notes = calib.fit_rates_auto(
+                samples, base=calib.default_profile(),
+                source="samples" if ns.samples else "microbench",
+            )
+            prof = fit.profile
+        rep = calib.drift_report(prof, samples)
+    except calib.CalibrationError as e:
+        print(f"calibrate: {e}", file=sys.stderr)
+        return 2
+
+    out_path = samples_path = None
+    if not ns.check and ns.out:
+        out_path = calib.save_profile(prof, ns.out)
+    if ns.samples_out:
+        samples_path = calib.save_samples(samples, ns.samples_out)
+
+    # the same shape as the bench record's `calibration` block, so one
+    # schema (scripts/check_bench_schema.py _CALIBRATION) pins both
+    block = {
+        "profile": prof.label(),
+        "fingerprint": calib.backend_fingerprint(),
+        "source": prof.source,
+        "fitted": bool(prof.fitted),
+        "samples": len(samples),
+        "residual_pct": (round(fit.residual * 100.0, 3)
+                         if fit is not None else -1.0),
+        "drift_pct": rep["drift_pct"],
+        "max_sample_drift_pct": rep["max_sample_drift_pct"],
+        "drift_ok": rep["drift_ok"],
+        "rates": {
+            "clock_hz": prof.clock_hz,
+            "vpu_lanes_per_cycle": prof.vpu_lanes_per_cycle,
+            "mxu_cyc_per_elem": prof.mxu_cyc_per_elem,
+            "hbm_bps": prof.hbm_bps,
+            "gather_rows_per_cycle": prof.gather_rows_per_cycle,
+            "dispatch_overhead_s": prof.dispatch_overhead_s,
+        },
+        "unfitted": sorted(prof.unfitted),
+        "fallback_notes": list(notes),
+        "surfaces": rep["surfaces"],
+    }
+    if ns.json:
+        print(_json.dumps({"calibration": block, "out": out_path,
+                           "samples_out": samples_path}))
+    else:
+        print(f"profile:  {block['profile']} "
+              f"(source={block['source']}, "
+              f"fitted={block['fitted']})")
+        for r, v in sorted(block["rates"].items()):
+            print(f"  {r:<22} {v:g}")
+        if block["unfitted"]:
+            print(f"  unfitted (inherited): "
+                  f"{', '.join(block['unfitted'])}")
+        for n in notes:
+            print(f"  [fallback] {n}")
+        for surf, e in sorted(rep["surfaces"].items()):
+            print(f"drift[{surf}]: modeled {e['modeled_s']:.4f}s vs "
+                  f"measured {e['measured_s']:.4f}s over "
+                  f"{e['samples']} sample(s) = {e['drift_pct']:g}%")
+        verdict = "OK" if rep["drift_ok"] else "FAIL"
+        print(f"{verdict}: drift {rep['drift_pct']:g}% "
+              f"(tolerance {rep['tolerance_pct']:g}%), "
+              f"residual {block['residual_pct']:g}%")
+        if out_path:
+            print(f"profile -> {out_path}")
+        if samples_path:
+            print(f"samples -> {samples_path}")
+    return 0 if rep["drift_ok"] else 2
 
 
 def _apply_platform(platform: str, cpu_devices: int) -> None:
@@ -1100,6 +1262,8 @@ def main(argv=None):
         # returned (not sys.exit'd) so programmatic callers get the
         # code; the module tail exits with it
         return lint_main(argv[1:])
+    if argv and argv[0] == "calibrate":
+        return calibrate_main(argv[1:])
     ns = make_parser().parse_args(argv)
     _apply_platform(ns.platform, ns.cpu_devices)
     args = QueryArgs(

@@ -42,9 +42,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from libgrape_lite_tpu.ops.calibration import default_profile
+
 #: capacity env knob; falls back to the loader's GRAPE_HBM_BYTES gate
 FLEET_HBM_ENV = "GRAPE_FLEET_HBM_BYTES"
-DEFAULT_HBM_BYTES = 16 << 30  # one v5e chip
+#: one chip's HBM, from the shared RateProfile (pinned: one v5e)
+DEFAULT_HBM_BYTES = default_profile().hbm_capacity_bytes
 
 
 class FleetStats:

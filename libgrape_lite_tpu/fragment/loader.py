@@ -84,6 +84,40 @@ def _cache_dir(efile: str, vfile: str, spec: LoadGraphSpec, fnum: int) -> str:
 
 VALIDATE_LOAD_ENV = "GRAPE_VALIDATE_LOAD"
 
+#: degree-weighted chunk rebalancing gate (ROADMAP item 4): "1" folds
+#: `rebalance=True` into the spec BEFORE the cache signature is
+#: computed, so rebalanced and oid-range caches never alias.  At
+#: fnum 1 the rebalancer's single block IS the oid range — results are
+#: byte-identical (pinned in tests).
+REBALANCE_ENV = "GRAPE_PARTITION_REBALANCE"
+
+
+def _fold_rebalance_env(spec: LoadGraphSpec) -> LoadGraphSpec:
+    if spec.rebalance:
+        return spec
+    if os.environ.get(REBALANCE_ENV, "") in ("", "0", "off"):
+        return spec
+    import dataclasses
+
+    vf = int(os.environ.get(REBALANCE_ENV + "_VF", "0") or 0)
+    return dataclasses.replace(
+        spec, rebalance=True, rebalance_vertex_factor=vf
+    )
+
+
+def _shard_skew(partitioner, dst: np.ndarray, fnum: int) -> dict:
+    """Per-shard in-edge counts under one partitioner: the padded-max
+    bill every SPMD shard pays (the 1d term the partition ledger
+    prices).  skew = max/mean — 1.0 is a perfectly balanced cut."""
+    pids = partitioner.get_partition_id(dst)
+    counts = np.bincount(pids[pids >= 0], minlength=fnum)
+    mean = float(counts.mean()) if fnum else 0.0
+    return {
+        "max_shard_edges": int(counts.max()) if fnum else 0,
+        "mean_shard_edges": round(mean, 1),
+        "skew": round(float(counts.max()) / mean, 4) if mean else 1.0,
+    }
+
 
 def _validate_load(frag: ShardedEdgecutFragment) -> ShardedEdgecutFragment:
     """GRAPE_VALIDATE_LOAD=1 gate: structural validation of every host
@@ -125,7 +159,7 @@ def LoadGraph(
     the query it delays."""
     from libgrape_lite_tpu import obs
 
-    spec = spec or LoadGraphSpec()
+    spec = _fold_rebalance_env(spec or LoadGraphSpec())
     tr = obs.tracer()
 
     with tr.span("load_graph", efile=efile, fnum=comm_spec.fnum) as lsp:
@@ -166,6 +200,27 @@ def LoadGraph(
                 partitioner = Rebalancer(
                     spec.rebalance_vertex_factor
                 ).partition(oids, src, dst, comm_spec.fnum)
+                # record the skew the rebalancer fixed (in-edge counts
+                # of the pull direction, both orientations when
+                # undirected) vs the oid-range cut it replaced — only
+                # computed when engaged, the default path pays nothing
+                from libgrape_lite_tpu.fragment.partition import (
+                    PARTITION_STATS,
+                )
+
+                d_all = (dst if spec.directed
+                         else np.concatenate([dst, src]))
+                before = _shard_skew(
+                    make_partitioner(
+                        spec.partitioner_type, comm_spec.fnum, oids
+                    ), d_all, comm_spec.fnum,
+                )
+                after = _shard_skew(partitioner, d_all, comm_spec.fnum)
+                PARTITION_STATS["rebalance"] = {
+                    "fnum": comm_spec.fnum,
+                    "vertex_factor": spec.rebalance_vertex_factor,
+                    "before": before, "after": after,
+                }
             else:
                 partitioner = make_partitioner(
                     spec.partitioner_type, comm_spec.fnum, oids

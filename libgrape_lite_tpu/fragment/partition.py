@@ -41,12 +41,8 @@ from libgrape_lite_tpu.parallel.mirror import (
     exchange_bytes_ledger,
     vc2d_exchange_bytes,
 )
-from libgrape_lite_tpu.parallel.pipeline import (
-    CLOCK_HZ,
-    DEFAULT_OPS_PER_EDGE,
-    ICI_BPS,
-    VPU_LANES_PER_CYCLE,
-)
+from libgrape_lite_tpu.ops.calibration import active_profile
+from libgrape_lite_tpu.parallel.pipeline import DEFAULT_OPS_PER_EDGE
 
 # 1-D app name -> its registered 2-D vertex-cut twin.  min-fold apps
 # are byte-identical to the 1-D pull; PageRankVC's sum fold is
@@ -103,16 +99,19 @@ def partition_mode() -> str:
 def modeled_costs(src: np.ndarray, dst: np.ndarray, n_vertices: int,
                   fnum: int, *, directed: bool = False,
                   itemsize: int = 4,
-                  ops_per_edge: float | None = None) -> dict:
+                  ops_per_edge: float | None = None,
+                  profile=None) -> dict:
     """Price one round of the pull under both layouts.  `src`/`dst`
     are the RAW oid edge list (symmetrised internally when
     undirected, matching both loaders); shard/tile assignment follows
     the contiguous-range conventions of the map partitioner and
     VCPartitioner.  `itemsize` defaults to the f32 payload convention
     BOTH byte ledgers share (mirror.exchange_bytes_ledger) — mixing
-    conventions here would bias the 1-D-vs-2-D comparison."""
+    conventions here would bias the 1-D-vs-2-D comparison.  Rates come
+    from `profile` (default: the active RateProfile)."""
+    p = profile or active_profile()
     ope = DEFAULT_OPS_PER_EDGE if ops_per_edge is None else ops_per_edge
-    rate = VPU_LANES_PER_CYCLE * CLOCK_HZ
+    rate = p.vpu_lanes_per_cycle * p.clock_hz
     s = np.asarray(src)
     d = np.asarray(dst)
     if not directed:
@@ -132,7 +131,7 @@ def modeled_costs(src: np.ndarray, dst: np.ndarray, n_vertices: int,
     bytes_1d = (
         exchange_bytes_ledger(fnum, vp)["gather"] if fnum > 1 else 0
     )
-    t_1d = _round_up(max_shard, 128) * ope / rate + bytes_1d / ICI_BPS
+    t_1d = _round_up(max_shard, 128) * ope / rate + bytes_1d / p.ici_bps
 
     # 2-D: k x k oid-range tiles (VCPartitioner); one dst-side pull
     # per round on the symmetrised storage (two orientations when the
@@ -157,7 +156,7 @@ def modeled_costs(src: np.ndarray, dst: np.ndarray, n_vertices: int,
         max_tile = int(tile_counts.max())
         bytes_2d = vc2d_exchange_bytes(k, vc, itemsize=itemsize)
         t_2d = (
-            _round_up(max_tile, 128) * ope / rate + bytes_2d / ICI_BPS
+            _round_up(max_tile, 128) * ope / rate + bytes_2d / p.ici_bps
         )
         out["2d"] = {
             "k": k,
@@ -211,9 +210,10 @@ def resolve_partition(app_name: str, fnum: int, src: np.ndarray,
     from libgrape_lite_tpu.utils import logging as glog
 
     mode = partition_mode() if mode is None else mode
+    prof = active_profile()
     decision = {
         "app": app_name, "requested": mode, "fnum": fnum,
-        "mode": "1d", "engaged": False,
+        "mode": "1d", "engaged": False, "profile": prof.label(),
     }
 
     def declined(why: str, count: bool = True):
@@ -237,7 +237,8 @@ def resolve_partition(app_name: str, fnum: int, src: np.ndarray,
         return declined(why)
     k = int(round(np.sqrt(fnum)))
     n_vertices = int(np.asarray(oids).max()) + 1 if len(oids) else 1
-    costs = modeled_costs(src, dst, n_vertices, fnum, directed=directed)
+    costs = modeled_costs(src, dst, n_vertices, fnum,
+                          directed=directed, profile=prof)
     decision["costs"] = costs
     if "2d" not in costs:
         return declined("cost model found no k^2 tiling")

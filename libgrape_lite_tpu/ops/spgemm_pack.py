@@ -66,13 +66,12 @@ import numpy as np
 C = 128          # lane width == K-tile width (one [128,128]-bit tile)
 WPT = C // 32    # uint32 words per bitmap row per K-tile
 
-# explicit v5e rate assumptions for the auto backend pricing — the SAME
-# numbers scripts/pack_cost_model.py prices the SpMV ledger with (kept
-# literal here: the recount gate must stay independent of this module)
-_VPU_LANES_PER_CYCLE = 1024
-_MXU_CYC_PER_ELEM = 0.008
-_CLOCK_HZ = 940e6
-_HBM_BPS = 819e9
+# Rate assumptions for the auto backend pricing come from the shared
+# RateProfile (ops/calibration.py) — the same rates every other priced
+# surface reads, fitted or pinned.  Only the op-count CONVENTIONS below
+# stay literal: the recount gate in scripts/pack_cost_model compares op
+# counts (rates cancel in the mismatch), so sharing rates is safe while
+# sharing counts would make the gate tautological.
 
 # modeled per-item op counts (counting conventions, shared with the
 # independent recount in scripts/pack_cost_model.spgemm_recount — a
@@ -769,24 +768,30 @@ def intersect_ledger_geom(n_pad: int, ep_oe: int, ep_ie: int,
     }
 
 
-def price_backends(spgemm_ledger: dict, intersect: dict) -> dict:
-    """Modeled seconds for both backends at the shared v5e rates (the
-    pack cost model's conventions: VPU lanes + MXU elems + gather rows
-    summed, HBM concurrent)."""
+def price_backends(spgemm_ledger: dict, intersect: dict,
+                   profile=None) -> dict:
+    """Modeled seconds for both backends at the shared profile rates
+    (the pack cost model's conventions: VPU lanes + MXU elems + gather
+    rows summed, HBM concurrent).  `profile` defaults to the active
+    RateProfile — a fitted profile re-prices the auto choice."""
+    from libgrape_lite_tpu.ops.calibration import active_profile
+
+    p = profile or active_profile()
     t = spgemm_ledger["totals"]
     sp = max(
-        t["vpu_ops"] / _VPU_LANES_PER_CYCLE / _CLOCK_HZ
-        + t["mxu_ops"] * _MXU_CYC_PER_ELEM / _CLOCK_HZ
-        + t["gather_rows"] / C / _CLOCK_HZ,
-        t["hbm_bytes"] / _HBM_BPS,
+        t["vpu_ops"] / p.vpu_lanes_per_cycle / p.clock_hz
+        + t["mxu_ops"] * p.mxu_cyc_per_elem / p.clock_hz
+        + t["gather_rows"] / p.gather_rows_per_cycle / p.clock_hz,
+        t["hbm_bytes"] / p.hbm_bps,
     )
     it = max(
-        intersect["word_ops"] / _VPU_LANES_PER_CYCLE / _CLOCK_HZ,
-        intersect["hbm_bytes"] / _HBM_BPS,
+        intersect["word_ops"] / p.vpu_lanes_per_cycle / p.clock_hz,
+        intersect["hbm_bytes"] / p.hbm_bps,
     )
     return {
         "t_spgemm_s": sp, "t_intersect_s": it,
         "spgemm_wins": bool(sp < it),
+        "profile": p.label(),
     }
 
 
@@ -847,6 +852,7 @@ def resolve_lcc_backend(app_name: str, frag,
         "t_spgemm_s": round(prices["t_spgemm_s"], 6),
         "t_intersect_s": round(prices["t_intersect_s"], 6),
         "items": plan.items, "mask_edges": plan.mask_edges,
+        "profile": prices["profile"],
     }
     _record("decisions", rec)
     if backend == "intersect":

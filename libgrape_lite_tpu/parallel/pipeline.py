@@ -126,18 +126,37 @@ def pipeline_min_bytes() -> int:
     return int(v) if v else _MIN_BYTES_DEFAULT
 
 
-# modeled rates for the overlap term (same explicit-assumption style
-# as scripts/pack_cost_model.py, which recounts this model from the
-# shipped arrays and gates on >5% drift)
-VPU_LANES_PER_CYCLE = 1024      # one (8,128) vreg op per cycle
-CLOCK_HZ = 940e6                # v5e core clock
-ICI_BPS = 9e10                  # ~2x45 GB/s v5e ICI links, per device
-DEFAULT_OPS_PER_EDGE = 30.0     # XLA gather+segment fold, no pack ledger
+# Modeled rates for the overlap term come from the shared RateProfile
+# (ops/calibration.py) — the module-level names stay as the pinned
+# default's values for importers (fragment/partition.py, the recount
+# in scripts/pack_cost_model.py) but live pricing reads the ACTIVE
+# profile, so a fitted profile re-prices the engage decision.
+from libgrape_lite_tpu.ops.calibration import (
+    active_profile as _active_profile,
+    default_profile as _default_profile,
+)
+
+VPU_LANES_PER_CYCLE = _default_profile().vpu_lanes_per_cycle
+CLOCK_HZ = _default_profile().clock_hz
+ICI_BPS = _default_profile().ici_bps
+DEFAULT_OPS_PER_EDGE = 30.0     # op COUNT per edge (XLA gather+segment
+#                                 fold, no pack ledger) — a counting
+#                                 convention, not a rate; stays literal
+
+
+def pipeline_min_hidden_us() -> float:
+    """Priced engage floor (µs): in auto mode the overlap model must
+    hide at least this much exchange per round or the pipeline
+    declines.  Default 0 — the shipped byte threshold alone decides,
+    bit-for-bit the pre-calibration behavior."""
+    v = os.environ.get("GRAPE_PIPELINE_MIN_HIDDEN_US", "")
+    return float(v) if v else 0.0
 
 
 def overlap_model(boundary_edges: int, interior_edges: int,
                   exchange_bytes: int,
-                  ops_per_edge: float | None = None) -> dict:
+                  ops_per_edge: float | None = None,
+                  profile=None) -> dict:
     """The exchange-overlap term of the op-budget ledger:
 
         t_serial    = compute_b + compute_i + exchange
@@ -148,11 +167,12 @@ def overlap_model(boundary_edges: int, interior_edges: int,
     under interior compute (min(compute_i, exchange) / exchange) —
     the number the bench `pipeline` block and the obs query span
     report, and trace_report flags when it lands under 10%."""
+    p = profile or _active_profile()
     ope = DEFAULT_OPS_PER_EDGE if ops_per_edge is None else ops_per_edge
-    rate = VPU_LANES_PER_CYCLE * CLOCK_HZ
+    rate = p.vpu_lanes_per_cycle * p.clock_hz
     t_b = boundary_edges * ope / rate
     t_i = interior_edges * ope / rate
-    t_x = exchange_bytes / ICI_BPS
+    t_x = exchange_bytes / p.ici_bps
     t_serial = t_b + t_i + t_x
     t_pipe = pipelined_round_s(t_i, t_x, t_b)
     hidden = min(t_i, t_x) / t_x if t_x > 0 else 0.0
@@ -365,7 +385,9 @@ def resolve_pipeline(frag, *, app_name: str, key: str,
     from libgrape_lite_tpu.utils import logging as glog
 
     mode = pipeline_mode()
-    decision = {"app": app_name, "mode": mode, "engaged": False}
+    prof = _active_profile()
+    decision = {"app": app_name, "mode": mode, "engaged": False,
+                "profile": prof.label()}
 
     def declined(why: str, count: bool = True):
         decision["reason"] = why
@@ -411,6 +433,23 @@ def resolve_pipeline(frag, *, app_name: str, key: str,
 
     bmask = boundary_split(frag, (direction,))
     stats = boundary_stats(frag, bmask, direction)
+
+    min_hidden = pipeline_min_hidden_us()
+    if mode == "auto" and min_hidden > 0:
+        tot = stats["totals"]
+        model = overlap_model(
+            tot.get("boundary_edges", 0), tot.get("interior_edges", 0),
+            xbytes, profile=prof,
+        )
+        hidden_us = min(model["compute_interior_s"],
+                        model["exchange_s"]) * 1e6
+        decision["modeled_hidden_us"] = round(hidden_us, 3)
+        if hidden_us < min_hidden:
+            return declined(
+                f"modeled hidden exchange {hidden_us:.2f}us under "
+                f"profile {prof.label()} is below the "
+                f"GRAPE_PIPELINE_MIN_HIDDEN_US={min_hidden:g} floor"
+            )
 
     pack_b = pack_i = None
     host_entries = {}

@@ -45,6 +45,18 @@ from libgrape_lite_tpu.serve.queue import (
 from libgrape_lite_tpu.worker.worker import Worker
 
 
+def _calibration_harvester():
+    """The live-harvest hook (ops/calibration.py): when
+    GRAPE_CALIBRATE_HARVEST is armed, returns the callable that joins
+    a dispatch's telemetry `device_us` stamp to its worker's shipped
+    pack-ledger recount; None (the common case) costs one env read."""
+    from libgrape_lite_tpu.ops import calibration
+
+    if not calibration.harvest_armed():
+        return None
+    return calibration.harvest_from_worker
+
+
 class ServeSession:
     def __init__(self, fragment, apps: Dict | None = None,
                  policy: BatchPolicy | None = None,
@@ -563,6 +575,8 @@ class ServeSession:
             stages["harvest_us"] = (
                 _time.perf_counter_ns() - t_exec
             ) // 1000
+            if _calibration_harvester() is not None:
+                _calibration_harvester()(w, stages, w.rounds)
             return ServeResult(
                 request_id=req.id, app_key=req.app_key, ok=True,
                 values=vals, rounds=w.rounds,
@@ -604,6 +618,14 @@ class ServeSession:
                 for b, req in enumerate(batch)
             ]
         stages = self._exec_stages(w, t_exec - t0)
+        if _calibration_harvester() is not None:
+            # the vmapped batch runs every lane to the max round in
+            # lockstep, so the device stamp covers rounds x lanes of
+            # the per-round ledger columns
+            br = w.batch_rounds
+            rounds = (max(int(r) for r in br)
+                      if br is not None and len(br) else w.rounds)
+            _calibration_harvester()(w, stages, rounds * len(batch))
         results = []
         breaches = w.batch_breaches or [None] * len(batch)
         for b, req in enumerate(batch):

@@ -444,4 +444,50 @@ grep -q "REGRESSION" "$OUT/bench_cmp.txt"
 grep -q "telemetry.stages.device_us.p99" "$OUT/bench_cmp.txt"
 echo "  OK (self-compare clean, archived r05 skipped-not-failed, seeded 2x regression exits 2)"
 
+echo "== calibration: CPU rate fit + drift gate (ops/calibration.py) =="
+# the r17 self-calibrating cost-ledger loop end to end on the CPU
+# backend: fit a profile from a measured sweep (persisting sweep +
+# profile), re-gate the RECORDED samples under the fitted profile
+# (deterministic — no scheduler re-race), then prove a deliberately
+# corrupted profile trips the 5% drift gate with exit 2, standalone
+# AND through the bench calibration lane
+timeout 900 python scripts/calibrate.py --scales 11,12 --repeats 3 \
+  --out "$OUT/rates.json" --samples-out "$OUT/rate_samples.json" \
+  > "$OUT/calibrate.txt"
+timeout 300 python scripts/calibrate.py --check \
+  --samples "$OUT/rate_samples.json" --profile "$OUT/rates.json" > /dev/null
+python - "$OUT/rates.json" "$OUT/rates_bad.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+d["vpu_lanes_per_cycle"] *= 20            # a deliberately wrong rate
+json.dump(d, open(sys.argv[2], "w"))
+EOF
+set +e
+timeout 300 python scripts/calibrate.py --check \
+  --samples "$OUT/rate_samples.json" --profile "$OUT/rates_bad.json" \
+  > "$OUT/calibrate_bad.txt" 2>&1
+CAL_RC=$?
+set -e
+test "$CAL_RC" -eq 2 \
+  || { echo "CORRUPTED PROFILE NOT GATED (rc=$CAL_RC)" >&2; cat "$OUT/calibrate_bad.txt"; exit 1; }
+# the bench lane under the same profile/samples: fitted passes, the
+# corrupted profile exits 2 (every other lane skipped — this tests
+# the gate, not the measurements)
+BENCH_CAL="GRAPE_BENCH_SCALE=10 GRAPE_BENCH_NO_PROBE=1 \
+  GRAPE_BENCH_NO_LEDGER=1 GRAPE_BENCH_NO_GUARD=1 GRAPE_BENCH_NO_SERVE=1 \
+  GRAPE_BENCH_NO_SERVE_ASYNC=1 GRAPE_BENCH_NO_DYN=1 \
+  GRAPE_BENCH_NO_PIPELINE=1 GRAPE_BENCH_NO_P2D=1 GRAPE_BENCH_NO_SPGEMM=1 \
+  GRAPE_BENCH_NO_FLEET=1 GRAPE_BENCH_NO_AUTOPILOT=1 \
+  GRAPE_BENCH_NO_TELEMETRY=1 GRAPE_CALIBRATION_SAMPLES=$OUT/rate_samples.json"
+env $BENCH_CAL GRAPE_RATE_PROFILE="$OUT/rates.json" \
+  python bench.py > "$OUT/bench_calibrated.json" 2>/dev/null
+set +e
+env $BENCH_CAL GRAPE_RATE_PROFILE="$OUT/rates_bad.json" \
+  python bench.py > /dev/null 2> "$OUT/bench_calibrated_bad.err"
+BCAL_RC=$?
+set -e
+test "$BCAL_RC" -eq 2 \
+  || { echo "BENCH DRIFT GATE NOT TRIPPED (rc=$BCAL_RC)" >&2; cat "$OUT/bench_calibrated_bad.err"; exit 1; }
+echo "  OK (fit within gate, corrupted profile exits 2 standalone + via bench)"
+
 echo "ALL APP TESTS PASSED"

@@ -19,7 +19,12 @@ it walks the schema's own block declarations (`_BLOCKS` /
   against the full-scale BENCH_r*.json gates nothing silently;
 * exits 2 when any gated field regresses by more than
   --threshold-pct (default 10%), 0 otherwise — self-compare is
-  exactly 0 regressions by construction.
+  exactly 0 regressions by construction;
+* applies ONE absolute gate on top of the relative ones: a candidate
+  `calibration` block reporting >5% modeled-vs-measured drift (or
+  drift_ok false) exits 2 regardless of the baseline — rate drift is
+  judged against device truth (ops/calibration.py), and a baseline
+  that drifted just as far is no excuse.
 
 Usage: python scripts/bench_compare.py BASELINE CANDIDATE
            [--threshold-pct 10] [--json]
@@ -57,6 +62,29 @@ _HIGHER_BETTER = {
     "value", "vs_baseline", "qps", "updates_per_s", "qps_win_b8",
     "inc_speedup",
 }
+
+
+#: the r17 ABSOLUTE gate (ops/calibration.py, docs/CALIBRATION.md): a
+#: candidate whose `calibration` block reports more than this
+#: modeled-vs-measured drift fails the compare outright — drift is
+#: against device truth, so a baseline that drifted just as far is no
+#: excuse (unlike every relative gate below)
+_DRIFT_LIMIT_PCT = 5.0
+
+
+def calibration_drift_failure(cand: dict):
+    """The reason string when the candidate's calibration block fails
+    the absolute drift gate, else None (no block = nothing gated)."""
+    blk = cand.get("calibration")
+    if not isinstance(blk, dict):
+        return None
+    drift = blk.get("drift_pct")
+    if blk.get("drift_ok") is False or (
+            _is_num(drift) and drift > _DRIFT_LIMIT_PCT):
+        return (f"calibration drift {drift}% exceeds the absolute "
+                f"{_DRIFT_LIMIT_PCT:g}% gate under profile "
+                f"{blk.get('profile')!r}")
+    return None
 
 
 def _direction(leaf: str) -> int:
@@ -174,14 +202,16 @@ def main(argv=None) -> int:
     regressions = [
         r for r in rows if r["regress_pct"] > ns.threshold_pct
     ]
+    drift_fail = calibration_drift_failure(cand)
     if ns.json:
         print(json.dumps({
             "threshold_pct": ns.threshold_pct,
             "compared": rows,
             "skipped": skipped,
             "regressions": [r["field"] for r in regressions],
+            "calibration_drift": drift_fail,
         }))
-        return 2 if regressions else 0
+        return 2 if (regressions or drift_fail) else 0
     print(f"bench_compare: {len(rows)} gated field(s), threshold "
           f"{ns.threshold_pct:g}%")
     for r in rows:
@@ -191,6 +221,9 @@ def main(argv=None) -> int:
               f"{r['candidate']:>12g} ({r['delta_pct']:+.1f}%){mark}")
     for where, why in skipped:
         print(f"  [skip] {where}: not comparable ({why})")
+    if drift_fail:
+        print(f"FAIL: {drift_fail}")
+        return 2
     if regressions:
         print(f"FAIL: {len(regressions)} field(s) regressed "
               f">{ns.threshold_pct:g}%")

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Calibration CI entry point (ops/calibration.py, docs/CALIBRATION.md).
+
+Thin wrapper over `python -m libgrape_lite_tpu.cli calibrate` so CI
+and shell hooks have a stable script path next to the other gates:
+
+    python scripts/calibrate.py --platform cpu \
+        --out scratch/rates.json --samples-out scratch/samples.json
+    python scripts/calibrate.py --check --samples scratch/samples.json
+
+Exit codes: 0 fit ok / drift gate passed, 2 infeasible fit or the
+active profile drifts >5% from the measured walls.
+
+scripts/app_tests.sh runs a CPU calibrate + drift check on every CI
+pass; scripts/tpu_first_light.sh fits the first real-TPU profile and
+re-gates the bench under it.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from libgrape_lite_tpu.cli import calibrate_main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(calibrate_main(sys.argv[1:]))

@@ -328,6 +328,37 @@ _AUTOPILOT = {
     "post_ingest_identical": (bool, True),
 }
 
+# the r17 calibration lane (ops/calibration.py, docs/CALIBRATION.md):
+# the fitted-rate record — the ACTIVE profile's label/fingerprint, the
+# fit's sample count and RMS residual, the per-surface aggregate
+# modeled-vs-measured drift (the 5% gate bench exits 2 on when an
+# explicit GRAPE_RATE_PROFILE drifts), and the fitted rate values
+# themselves so PERF_NOTES can table pinned-vs-fitted.  Verdict
+# fields are DECLARED bool; every rate is numeric with bool rejected
+# (the R5 class) via the extra rates-dict walk in validate_record.
+_CALIBRATION = {
+    "profile": (str, True),
+    "fingerprint": (str, True),
+    "source": (str, True),
+    "fitted": (bool, True),
+    "samples": (int, True),
+    "residual_pct": (_NUM, True),
+    "drift_pct": (_NUM, True),
+    "max_sample_drift_pct": (_NUM, True),
+    "drift_ok": (bool, True),
+    "rates": (dict, True),
+    "unfitted": (list, False),
+    "fallback_notes": (list, False),
+    "surfaces": (dict, False),
+}
+
+_CALIB_SURFACE = {
+    "modeled_s": (_NUM, True),
+    "measured_s": (_NUM, True),
+    "samples": (int, True),
+    "drift_pct": (_NUM, True),
+}
+
 #: every nested block bench.py may emit — THE single declaration
 #: point; _TOP, SCHEMA, validate_record and the CLI listing all
 #: derive from it (self_check() pins the derivation)
@@ -345,6 +376,7 @@ _BLOCKS = {
     "fleet": _FLEET,
     "telemetry": _TELEMETRY,
     "autopilot": _AUTOPILOT,
+    "calibration": _CALIBRATION,
 }
 
 _TOP = {**_TOP_SCALARS, **{k: (dict, False) for k in _BLOCKS}}
@@ -540,6 +572,33 @@ def validate_record(record) -> list:
                 errors.append(f"{where}: expected object")
                 continue
             _check_block(point, _STAGE_POINT, where, errors)
+    cb = record.get("calibration")
+    if isinstance(cb, dict):
+        rates = cb.get("rates")
+        if isinstance(rates, dict):
+            for k, v in rates.items():
+                if not isinstance(v, _NUM) or isinstance(v, bool):
+                    errors.append(
+                        f"calibration.rates[{k!r}]: expected number, "
+                        f"got {type(v).__name__}"
+                    )
+        for lf in ("unfitted", "fallback_notes"):
+            seq = cb.get(lf)
+            if isinstance(seq, list):
+                for i, v in enumerate(seq):
+                    if not isinstance(v, str):
+                        errors.append(
+                            f"calibration.{lf}[{i}]: expected str, "
+                            f"got {type(v).__name__}"
+                        )
+        surfs = cb.get("surfaces")
+        if isinstance(surfs, dict):
+            for sname, point in surfs.items():
+                where = f"calibration.surfaces[{sname!r}]"
+                if not isinstance(point, dict):
+                    errors.append(f"{where}: expected object")
+                    continue
+                _check_block(point, _CALIB_SURFACE, where, errors)
     fl = record.get("fleet")
     if isinstance(fl, dict):
         pr = fl.get("per_replica")

@@ -58,15 +58,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 C = 128                       # lane width
-VPU_LANES_PER_CYCLE = 8 * C   # one (8,128) vreg op per cycle
-CLOCK_HZ = 940e6              # v5e core clock
-HBM_BPS = 819e9               # v5e HBM bandwidth
+# Pricing rates come from the shared RateProfile (ops/calibration.py)
+# — the pinned default carries exactly the hand-measured v5e numbers
+# this script used to inline, and a fitted profile (GRAPE_RATE_PROFILE)
+# re-prices every surface here without touching the recount
+# CONVENTIONS below (the recounts compare op COUNTS; rates cancel in
+# the mismatch, so sharing rates keeps the gate honest).
+from libgrape_lite_tpu.ops.calibration import (  # noqa: E402
+    active_profile,
+    default_profile,
+)
+
+VPU_LANES_PER_CYCLE = default_profile().vpu_lanes_per_cycle
+CLOCK_HZ = default_profile().clock_hz
+HBM_BPS = default_profile().hbm_bps
 BASELINE_MTEPS = 3500.0       # reference 8xV100 PageRank, per chip
-# sublane dynamic_gather rate bracket (slots/cycle): vreg = a full
-# (8,128) vector gathered per cycle, row = one 128-lane row per cycle,
-# unroll = Mosaic falls back to ~8-way select unrolling
-GATHER_RATES = {"vreg": 1024, "row": 128, "unroll": 16}
-MXU_CYC_PER_ELEM = 0.008      # verified triangular-matmul cumsum rate
+GATHER_RATES = default_profile().gather_rates
+MXU_CYC_PER_ELEM = default_profile().mxu_cyc_per_elem
 MISMATCH_TOLERANCE = 0.05
 
 
@@ -314,17 +322,19 @@ def spgemm_recount(plan) -> dict:
     }
 
 
-def price(totals: dict, edges: int) -> dict:
-    """Wall-clock + MTEPS bracket from ledger totals under the explicit
-    v5e rates; the gather rate is bracketed (the probe's unknown).
-    VPU, MXU and gather time are summed (no overlap assumed — the
-    conservative bound); HBM streams concurrently."""
-    vpu_s = totals["vpu_ops"] / VPU_LANES_PER_CYCLE / CLOCK_HZ
-    mxu_s = totals["mxu_ops"] * MXU_CYC_PER_ELEM / CLOCK_HZ
-    hbm_s = totals["hbm_bytes"] / HBM_BPS
+def price(totals: dict, edges: int, profile=None) -> dict:
+    """Wall-clock + MTEPS bracket from ledger totals under the shared
+    profile rates (default: the active RateProfile); the gather rate
+    is bracketed (the probe's unknown).  VPU, MXU and gather time are
+    summed (no overlap assumed — the conservative bound); HBM streams
+    concurrently."""
+    p = profile or active_profile()
+    vpu_s = totals["vpu_ops"] / p.vpu_lanes_per_cycle / p.clock_hz
+    mxu_s = totals["mxu_ops"] * p.mxu_cyc_per_elem / p.clock_hz
+    hbm_s = totals["hbm_bytes"] / p.hbm_bps
     scenarios = {}
-    for name, rate in GATHER_RATES.items():
-        g_s = totals["gather_rows"] / rate / CLOCK_HZ
+    for name, rate in p.gather_rates.items():
+        g_s = totals["gather_rows"] / rate / p.clock_hz
         t = max(vpu_s + mxu_s + g_s, hbm_s)
         scenarios[name] = dict(
             gather_ms=round(g_s * 1e3, 2),

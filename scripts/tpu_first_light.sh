@@ -128,4 +128,31 @@ timeout 1800 python scripts/pack_cost_model.py \
   echo "LEDGER/COST-MODEL MISMATCH (see $OUT/cost_model.err)" >&2
 }
 
+echo "== calibrate-then-recheck (r17, ops/calibration.py,
+docs/CALIBRATION.md): fit the FIRST real-TPU rate profile from
+measured device walls, persist profile + sweep, then re-run the
+bench drift lane UNDER the fitted profile — exit 2 there means the
+fit does not model the hardware it just measured =="
+timeout 1800 python scripts/calibrate.py \
+  --out "$OUT/rates.json" --samples-out "$OUT/rate_samples.json" \
+  2> "$OUT/calibrate.err" | tee "$OUT/calibrate.txt" || {
+  echo "CALIBRATION FIT/GATE FAILED (see $OUT/calibrate.err)" >&2
+}
+if [ -f "$OUT/rates.json" ]; then
+  GRAPE_RATE_PROFILE="$OUT/rates.json" \
+  GRAPE_CALIBRATION_SAMPLES="$OUT/rate_samples.json" \
+  GRAPE_BENCH_ASSUME_ALIVE=1 GRAPE_BENCH_SCALE=16 \
+  GRAPE_BENCH_NO_GUARD=1 GRAPE_BENCH_NO_SERVE=1 \
+  GRAPE_BENCH_NO_SERVE_ASYNC=1 GRAPE_BENCH_NO_DYN=1 \
+  GRAPE_BENCH_NO_PIPELINE=1 GRAPE_BENCH_NO_P2D=1 \
+  GRAPE_BENCH_NO_SPGEMM=1 GRAPE_BENCH_NO_FLEET=1 \
+  GRAPE_BENCH_NO_AUTOPILOT=1 GRAPE_BENCH_NO_TELEMETRY=1 \
+  GRAPE_BENCH_NO_LEDGER=1 \
+  timeout 1800 python bench.py \
+    > "$OUT/bench_calibrated.json" 2> "$OUT/bench_calibrated.err" || {
+    echo "CALIBRATED DRIFT GATE FAILED — the fitted profile drifts" \
+         ">5% from its own measurement (see $OUT/bench_calibrated.err)" >&2
+  }
+fi
+
 echo "== done; results in $OUT =="
