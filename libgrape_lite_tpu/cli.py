@@ -118,6 +118,15 @@ def make_parser() -> argparse.ArgumentParser:
                    help="jax platform override (e.g. cpu); default ambient")
     p.add_argument("--cpu_devices", type=int, default=0,
                    help="with --platform cpu: virtual device count")
+    p.add_argument("--coordinator", default="",
+                   help="jax.distributed coordinator address "
+                        "(host:port); arms the multi-process runtime "
+                        "together with --num_processes/--process_id")
+    p.add_argument("--num_processes", type=int, default=0,
+                   help="total process count for jax.distributed "
+                        "(0 = single-process)")
+    p.add_argument("--process_id", type=int, default=-1,
+                   help="this process's rank in [0, num_processes)")
     return p
 
 

@@ -43,6 +43,7 @@ from libgrape_lite_tpu.fleet.drain import (
     begin_drain,
     drain_replica,
     rejoin,
+    rejoin_lost,
 )
 from libgrape_lite_tpu.fleet.router import (
     FenceError,
@@ -76,6 +77,7 @@ __all__ = [
     "overlay_bytes",
     "plan_stream_bytes",
     "rejoin",
+    "rejoin_lost",
     "run_fleet_script",
     "runner_bytes",
     "session_footprint",

@@ -113,6 +113,51 @@ def rejoin(router, idx: int) -> dict:
     return report
 
 
+def rejoin_lost(router, checkpoint_dir: str, *, session_factory):
+    """Process-loss rejoin (docs/FAULT_TOLERANCE.md, "Distributed
+    resilience"): a replica lost to a dead rank cannot drain or replay
+    a catch-up log — its in-memory state is gone.  What survives is
+    the last committed sharded checkpoint.  This builds a REPLACEMENT
+    replica from a live sibling's fragment (`replicate_fragment`, the
+    same deterministic copy the autoscaler's scale-up uses), adds it
+    to rotation at the current fence, and returns `(replica, meta)`
+    where `meta` is the newest sharded snapshot's metadata — the
+    caller resumes interrupted checkpointed queries via
+    `Worker.resume`, which is reshard-aware (the snapshot restores
+    onto the replacement's mesh even when the gang shrank)."""
+    from libgrape_lite_tpu.fragment.mutation import replicate_fragment
+    from libgrape_lite_tpu.ft.checkpoint import latest_meta
+
+    meta = latest_meta(checkpoint_dir)
+    if meta.get("layout") != "sharded":
+        raise ValueError(
+            f"rejoin_lost needs a sharded (multi-process) checkpoint "
+            f"lineage; {checkpoint_dir!r} holds a "
+            f"{meta.get('layout', 'single-file')!r} layout — use the "
+            f"ordinary resume path for single-process loss"
+        )
+    live = [x for x in router.replicas if x.routable]
+    if not live:
+        raise ValueError(
+            "rejoin_lost: no live replica to replicate a fragment from"
+        )
+    sess = session_factory(replicate_fragment(live[0].session.fragment))
+    r = router.add_replica(sess)
+    tr = obs.tracer()
+    if tr.enabled:
+        tr.instant(
+            "fleet_rejoin_lost", replica=r.idx,
+            ckpt_rounds=int(meta["rounds"]),
+            ckpt_ranks=int(meta.get("ranks", 0)),
+        )
+    FLEET_STATS.record(
+        "rejoin", replica=r.idx, lost_process=True,
+        ckpt_rounds=int(meta["rounds"]),
+        ckpt_ranks=int(meta.get("ranks", 0)),
+    )
+    return r, meta
+
+
 def drain_replica(router, idx: int, *, offline=None) -> dict:
     """The one-call form: begin + rejoin immediately (no ingest can
     land in between, so the catch-up log is empty and the replica

@@ -21,6 +21,11 @@ pytree per cadence interval:
   typed retryable-error classification, wrapped around
   `jax.distributed.initialize` (parallel/comm_spec.py) and garc cache
   reads (fragment/loader.py).
+* `distributed` — the multi-process layer (docs/FAULT_TOLERANCE.md,
+  "Distributed resilience"): `ShardedCheckpointManager` writes
+  per-rank shard files under a two-phase commit barrier, and
+  `restore_resharded` gathers a snapshot's full carry from surviving
+  shards onto a *different* mesh (reshard-on-loss).
 """
 
 from libgrape_lite_tpu.ft.checkpoint import (
@@ -28,6 +33,11 @@ from libgrape_lite_tpu.ft.checkpoint import (
     CheckpointMismatchError,
     CorruptCheckpointError,
     restore_latest,
+)
+from libgrape_lite_tpu.ft.distributed import (
+    ShardedCheckpointManager,
+    load_sharded_state,
+    restore_resharded,
 )
 from libgrape_lite_tpu.ft.faults import FaultPlan, InjectedFault, active_plan
 from libgrape_lite_tpu.ft.fingerprint import compute_fingerprint
@@ -47,10 +57,13 @@ __all__ = [
     "InjectedFault",
     "RetryPolicy",
     "RetryableError",
+    "ShardedCheckpointManager",
     "active_plan",
     "compute_fingerprint",
     "is_transient_distributed_error",
     "is_transient_io_error",
+    "load_sharded_state",
     "restore_latest",
+    "restore_resharded",
     "with_retries",
 ]

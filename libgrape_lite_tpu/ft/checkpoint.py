@@ -194,7 +194,17 @@ def _restore_latest(
                 + "; ".join(diffs)
             )
         try:
-            state = load_state(path, meta)
+            if meta.get("layout") == "sharded":
+                # multi-process lineage (ft/distributed.py): gather
+                # the full carry from the per-rank shard files; the
+                # fingerprint check above already proved same-mesh
+                from libgrape_lite_tpu.ft.distributed import (
+                    load_sharded_state,
+                )
+
+                state = load_sharded_state(path, meta)
+            else:
+                state = load_state(path, meta)
         except CorruptCheckpointError as e:
             glog.log_info(f"skipping corrupt checkpoint {path}: {e}")
             last_err = e

@@ -9,6 +9,11 @@ Spec grammar — comma-separated tokens:
     kill@K            kill the process after superstep K's checkpoint
                       is durable (os._exit; `mode=raise` raises
                       InjectedFault instead, for in-process tests)
+    kill_rank@K:R     rank-targeted kill: same as kill@K but only on
+                      jax.process_index() == R — the 1-of-N process
+                      loss the reshard-on-loss restore drills
+                      (ft/distributed.py); the same spec can arm every
+                      rank of a gang and fire on exactly one
     corrupt@K         flip bytes in the newest checkpoint shard after
                       the superstep-K checkpoint lands (exercises the
                       corrupt-shard fallback on resume)
@@ -48,8 +53,8 @@ class InjectedFault(RuntimeError):
 
 
 SPEC_GRAMMAR = (
-    "kill@K, corrupt@K, corrupt_carry@K, capacity=N, mode=raise|exit, "
-    "exit=N"
+    "kill@K, kill_rank@K:R, corrupt@K, corrupt_carry@K, capacity=N, "
+    "mode=raise|exit, exit=N"
 )
 
 
@@ -85,6 +90,8 @@ def corrupt_file(path: str, nbytes: int = 16, offset: Optional[int] = None):
 @dataclass
 class FaultPlan:
     kill_at_superstep: Optional[int] = None
+    kill_rank_at: Optional[int] = None   # kill_rank@K:R superstep K
+    kill_rank: Optional[int] = None      # kill_rank@K:R rank R
     corrupt_checkpoint_at: Optional[int] = None
     corrupt_carry_at: Optional[int] = None
     capacity_clamp: Optional[int] = None
@@ -111,6 +118,19 @@ class FaultPlan:
                 plan.corrupt_carry_at = cls._int_of(
                     tok, tok[len("corrupt_carry@"):]
                 )
+            elif tok.startswith("kill_rank@"):
+                payload = tok[len("kill_rank@"):]
+                k, sep, r = payload.partition(":")
+                if not sep:
+                    raise FaultSpecError(
+                        tok, f"{payload!r} is not K:R (missing rank)"
+                    )
+                plan.kill_rank_at = cls._int_of(tok, k)
+                plan.kill_rank = cls._int_of(tok, r)
+                if plan.kill_rank < 0:
+                    raise FaultSpecError(
+                        tok, f"rank {plan.kill_rank} is negative"
+                    )
             elif tok.startswith("kill@"):
                 plan.kill_at_superstep = cls._int_of(tok, tok[len("kill@"):])
             elif tok.startswith("corrupt@"):
@@ -141,6 +161,7 @@ class FaultPlan:
     def is_noop(self) -> bool:
         return (
             self.kill_at_superstep is None
+            and self.kill_rank_at is None
             and self.corrupt_checkpoint_at is None
             and self.corrupt_carry_at is None
             and self.capacity_clamp is None
@@ -242,6 +263,29 @@ class FaultPlan:
             if self.mode == "raise":
                 raise InjectedFault(f"injected kill at superstep {rounds}")
             os._exit(self.exit_code)
+        if (
+            self.kill_rank_at is not None
+            and rounds == self.kill_rank_at
+            and self._this_rank() == self.kill_rank
+        ):
+            if manager is not None:
+                manager.wait()  # kill only after the checkpoint is durable
+            glog.log_info(
+                f"fault injection: killing rank {self.kill_rank} at "
+                f"superstep {rounds} (mode={self.mode})"
+            )
+            if self.mode == "raise":
+                raise InjectedFault(
+                    f"injected kill of rank {self.kill_rank} at "
+                    f"superstep {rounds}"
+                )
+            os._exit(self.exit_code)
+
+    @staticmethod
+    def _this_rank() -> int:
+        import jax
+
+        return jax.process_index()
 
 
 _NOOP = FaultPlan()

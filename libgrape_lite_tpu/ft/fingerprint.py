@@ -125,7 +125,23 @@ def compute_fingerprint(app, frag, query_args: Dict[str, Any]) -> Dict[str, Any]
         # numeric config that changes result bytes
         "x64": bool(jax.config.jax_enable_x64),
         "spmv_mode": os.environ.get("GRAPE_SPMV", "auto"),
+        # mesh geometry beyond fnum/vp: the partition layout and the
+        # process topology.  A 2-D-partition snapshot must never
+        # silently restore into a 1-D worker (the carry layouts
+        # differ), and a reshard restore must KNOW it is crossing a
+        # process-count change (ft/distributed.py GEOMETRY_KEYS) —
+        # both are loud CheckpointMismatchErrors, never guesses.
+        "partition_mode": _partition_mode(),
+        "processes": jax.process_count(),
     }
+
+
+def _partition_mode() -> str:
+    # local import: fragment/ pulls in the parallel stack; the
+    # fingerprint module must stay importable standalone
+    from libgrape_lite_tpu.fragment.partition import partition_mode
+
+    return partition_mode()
 
 
 def fingerprint_mismatch(expected: Dict, found: Dict) -> list[str]:

@@ -17,12 +17,33 @@ cannot classify is how double-initialization bugs get hidden.
 from __future__ import annotations
 
 import errno
+import os
 import random
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 from libgrape_lite_tpu.utils import logging as glog
+
+#: seeds the backoff-jitter RNG so a fault drill that crosses a retry
+#: is byte-reproducible (two runs with the same seed sleep the same
+#: sequence); unset = wall-entropy jitter, the storm-decorrelating
+#: default
+RETRY_SEED_ENV = "GRAPE_RETRY_SEED"
+
+
+def _default_rng() -> random.Random:
+    seed = os.environ.get(RETRY_SEED_ENV, "")
+    if not seed:
+        return random.Random()
+    try:
+        return random.Random(int(seed))
+    except ValueError:
+        raise ValueError(
+            f"{RETRY_SEED_ENV}={seed!r} is not an integer; a typo "
+            "must not silently decorrelate a drill that expected "
+            "deterministic backoff"
+        ) from None
 
 
 class RetryableError(Exception):
@@ -77,7 +98,7 @@ def with_retries(
     if policy.max_attempts < 1:
         raise ValueError(f"max_attempts must be >= 1, got {policy.max_attempts}")
     if rng is None and policy.jitter:
-        rng = random.Random()
+        rng = _default_rng()
     for attempt in range(policy.max_attempts):
         try:
             return fn()

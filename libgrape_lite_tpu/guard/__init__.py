@@ -20,6 +20,10 @@ recovery without an operator:
   rollback`; rollback restores the last good snapshot via
   `ft.checkpoint.restore_latest`, replays in stepwise "paranoid" mode
   (probe every round) to localize the faulty round, and continues.
+* **Cross-rank breach votes** (`vote.py`) — under `jax.distributed`,
+  every rank exchanges a verdict at each hazard boundary so one
+  rank's halt becomes an all-ranks halt (`RemoteBreachError`) at the
+  same superstep cut instead of stranding siblings in a collective.
 
 Execution contract: guards are OFF by default and the fused
 `shard_map(while_loop)` fast path is byte-identical with guards off
@@ -50,6 +54,7 @@ from libgrape_lite_tpu.guard.monitor import (
     GuardMonitor,
     InvariantBreachError,
 )
+from libgrape_lite_tpu.guard.vote import BreachVote, RemoteBreachError
 from libgrape_lite_tpu.guard.watchdog import DivergenceWatchdog, carry_digest
 
 __all__ = [
@@ -67,6 +72,8 @@ __all__ = [
     "InvariantBreachError",
     "DivergenceError",
     "GuardMonitor",
+    "BreachVote",
+    "RemoteBreachError",
     "DivergenceWatchdog",
     "carry_digest",
 ]

@@ -1,0 +1,158 @@
+"""Cross-rank breach votes: one rank's halt is every rank's halt.
+
+The guard probes are jitted GLOBAL computations, so an invariant
+breach or watchdog divergence produces the same verdict on every rank
+— but the *raise* is host-side, and under `jax.distributed` a raise
+on one rank strands its siblings blocked in the next superstep's
+collective (they never learn; the gang hangs until an external
+timeout).  Host-side failures are worse: an `InjectedFault`, an IO
+error in a checkpoint hook, anything rank-local, halts exactly one
+process.
+
+`BreachVote.round_vote` closes that gap with a tiny host-side
+allgather (`parallel.comm_spec.host_allgather`) at each superstep
+boundary where hazard hooks run: every rank votes (verdict code,
+superstep).  A healthy gang pays one 2-int32 exchange; any nonzero
+vote makes EVERY rank raise at the same consistent cut — the
+breaching rank re-raises its own error, the healthy ranks raise
+`RemoteBreachError` naming who halted and why, and nobody is left in
+a collective.  The vote also cross-checks the superstep number
+itself: ranks voting at different cuts is a lockstep violation worth
+halting over, not papering over.
+
+The worker arms the vote only when a hazard hook exists (guard,
+checkpointing, or an injected fault plan — all env/flag-symmetric
+across ranks) and only under `jax.process_count() > 1`, so
+single-process behavior is bit-identical with the module never
+imported.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from libgrape_lite_tpu.guard.monitor import (
+    DivergenceError,
+    GuardError,
+    InvariantBreachError,
+)
+
+VOTE_HEALTHY = 0
+VOTE_INVARIANT = 1
+VOTE_DIVERGENCE = 2
+VOTE_FAULT = 3
+VOTE_ERROR = 4
+
+_VOTE_NAMES = {
+    VOTE_HEALTHY: "healthy",
+    VOTE_INVARIANT: "invariant breach",
+    VOTE_DIVERGENCE: "divergence",
+    VOTE_FAULT: "injected fault",
+    VOTE_ERROR: "host-side error",
+}
+
+
+class RemoteBreachError(GuardError):
+    """Another rank voted a halt at this superstep; this rank is
+    healthy and halts in lockstep instead of blocking in the next
+    collective.  `.bundle` names the voting ranks and their verdict
+    codes."""
+
+
+def classify_breach_error(err: Optional[BaseException]) -> int:
+    """The vote code for a caught hazard-hook error (the specific
+    guard verdicts keep their identity across the wire; anything else
+    is a host-side error)."""
+    if err is None:
+        return VOTE_HEALTHY
+    if isinstance(err, DivergenceError):
+        return VOTE_DIVERGENCE
+    if isinstance(err, InvariantBreachError):
+        return VOTE_INVARIANT
+    from libgrape_lite_tpu.ft.faults import InjectedFault
+
+    if isinstance(err, InjectedFault):
+        return VOTE_FAULT
+    return VOTE_ERROR
+
+
+class BreachVote:
+    """One breach-vote endpoint per process.  `allgather`, `rank` and
+    `nprocs` are injectable so the quorum logic is unit-testable in
+    one process."""
+
+    def __init__(self, *, rank: Optional[int] = None,
+                 nprocs: Optional[int] = None, allgather=None):
+        import jax
+
+        self.rank = jax.process_index() if rank is None else int(rank)
+        self.nprocs = (
+            jax.process_count() if nprocs is None else int(nprocs)
+        )
+        if allgather is None:
+            from libgrape_lite_tpu.parallel.comm_spec import (
+                host_allgather,
+            )
+
+            allgather = host_allgather
+        self._allgather = allgather
+
+    @classmethod
+    def for_current_process(cls) -> Optional["BreachVote"]:
+        """The process's vote endpoint, or None single-process (the
+        caller skips voting entirely — zero overhead, bit-identical
+        behavior)."""
+        import jax
+
+        if jax.process_count() <= 1:
+            return None
+        return cls()
+
+    def round_vote(self, rounds: int,
+                   err: Optional[BaseException] = None) -> None:
+        """Exchange this superstep's verdict with every rank.  Always
+        raises when any rank (this one included) voted unhealthy:
+        `err` re-raised locally, `RemoteBreachError` on healthy ranks.
+        Returns normally only on a unanimous healthy vote."""
+        code = classify_breach_error(err)
+        votes = np.asarray(self._allgather(
+            np.asarray([code, int(rounds)], np.int32)
+        ))
+        if votes.shape[0] != self.nprocs:
+            raise RemoteBreachError(
+                f"breach vote returned {votes.shape[0]} rows for "
+                f"{self.nprocs} processes",
+                {"rounds": int(rounds)},
+            )
+        if err is not None:
+            # every sibling saw the vote and is halting too; the
+            # breaching rank keeps its own (more specific) error
+            raise err
+        codes = votes[:, 0]
+        rds = votes[:, 1]
+        if not np.all(rds == int(rounds)):
+            raise RemoteBreachError(
+                "breach vote out of lockstep: per-rank supersteps "
+                f"{rds.tolist()} (this rank {self.rank} at "
+                f"{int(rounds)})",
+                {"rounds": rds.tolist(), "codes": codes.tolist()},
+            )
+        bad = np.nonzero(codes != VOTE_HEALTHY)[0]
+        if bad.size:
+            detail = ", ".join(
+                f"rank {int(r)}: "
+                f"{_VOTE_NAMES.get(int(codes[r]), int(codes[r]))}"
+                for r in bad
+            )
+            raise RemoteBreachError(
+                f"halt voted at superstep {int(rounds)}: {detail} "
+                f"(this rank {self.rank} is healthy and halts in "
+                "lockstep)",
+                {
+                    "rounds": int(rounds),
+                    "ranks": [int(r) for r in bad],
+                    "codes": [int(codes[r]) for r in bad],
+                },
+            )

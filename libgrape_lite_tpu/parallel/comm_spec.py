@@ -20,6 +20,20 @@ VC_COL_AXIS = "vccol"
 kCoordinatorRank = 0  # reference grape/config.h:64
 
 
+def host_allgather(vec: np.ndarray) -> np.ndarray:
+    """Host-side allgather of a small vector, stacked `[nprocs, ...]`
+    — the control plane under `ft/distributed.py`'s two-phase commit
+    barriers and `guard/vote.py`'s breach votes.  Single-process it
+    degenerates to stacking the input alone, touching no backend, so
+    the callers' quorum logic is identical at every process count."""
+    v = np.asarray(vec)
+    if jax.process_count() <= 1:
+        return v[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(v))
+
+
 def put_global(x, sharding: NamedSharding):
     """`jax.device_put` honoring multi-process meshes: when the
     sharding spans non-addressable devices (a jax.distributed run),
@@ -59,6 +73,17 @@ class CommSpec:
         retried with exponential backoff (`ft/retry.py`); contract
         violations (late call, double init) are never retried."""
         if num_processes and num_processes > 1:
+            # the CPU backend runs cross-process collectives over gloo,
+            # but only if the implementation is selected BEFORE the
+            # backend comes up — without this every multi-process
+            # computation dies with "Multiprocess computations aren't
+            # implemented on the CPU backend".  TPU/GPU ignore it.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except Exception:
+                pass  # jaxlib built without gloo: CPU gangs unsupported
             from libgrape_lite_tpu.ft.retry import (
                 DISTRIBUTED_INIT_POLICY,
                 is_late_init_error,

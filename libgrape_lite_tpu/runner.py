@@ -43,6 +43,12 @@ class QueryArgs:
     cdlp_mr: int = 10
     degree_threshold: int = 0
     fnum: int | None = None
+    # jax.distributed gang membership (parallel/comm_spec.py:
+    # init_distributed runs before any backend use when
+    # num_processes > 1); 0/unset = single-process
+    coordinator: str = ""
+    num_processes: int = 0
+    process_id: int = -1
     partitioner_type: str = "map"
     idxer_type: str = "hashmap"
     rebalance: bool = False
@@ -113,6 +119,25 @@ def run_app(args: QueryArgs, comm_spec: CommSpec | None = None) -> Worker:
     if args.checkpoint_dir and not (args.checkpoint_every or args.resume):
         raise ValueError(
             "--checkpoint_dir requires --checkpoint_every (or --resume)"
+        )
+    if args.num_processes and args.num_processes > 1:
+        if args.process_id < 0 or not args.coordinator:
+            raise ValueError(
+                "--num_processes > 1 requires --coordinator and "
+                "--process_id (every member of the gang names itself)"
+            )
+        if comm_spec is not None:
+            raise ValueError(
+                "pass EITHER a prebuilt comm_spec or the "
+                "--coordinator/--num_processes/--process_id flags, "
+                "not both"
+            )
+        # must run before the partition probe or load touch a backend
+        comm_spec = CommSpec.init_distributed(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+            fnum=args.fnum,
         )
     if args.trace or args.metrics:
         # arm obs/ BEFORE the load so the load_graph span is captured;

@@ -1815,15 +1815,6 @@ class Worker:
                 raise ValueError(
                     f"checkpoint_every must be >= 1, got {checkpoint_every}"
                 )
-            if jax.process_count() > 1:
-                # the writer snapshots the carry with np.asarray, which
-                # requires fully-addressable arrays; multi-host needs
-                # per-process shard files + a commit barrier (ROADMAP)
-                raise NotImplementedError(
-                    "superstep checkpointing is single-host for now: the "
-                    "carry spans non-addressable devices in a "
-                    "jax.distributed run"
-                )
         if getattr(app, "host_only", False):
             return self.query(max_rounds, **query_args)
         mr = app.max_rounds if max_rounds is None else max_rounds
@@ -1848,17 +1839,41 @@ class Worker:
         resume_meta = None
         if checkpointing:
             from libgrape_lite_tpu.ft.checkpoint import (
-                CheckpointManager, CheckpointMismatchError, restore_latest,
+                CheckpointManager, CheckpointMismatchError, latest_meta,
+                restore_latest,
             )
             from libgrape_lite_tpu.ft.fingerprint import (
                 canonical_query_args, compute_fingerprint,
             )
 
+            distributed = jax.process_count() > 1
             fingerprint = compute_fingerprint(app, frag, query_args)
             if _resume:
-                restored, resume_meta = restore_latest(
-                    checkpoint_dir, fingerprint
-                )
+                meta0 = latest_meta(checkpoint_dir)
+                fp0 = meta0.get("fingerprint", {})
+                if meta0.get("layout") == "sharded" and (
+                    (fp0.get("fnum"), fp0.get("vp"), fp0.get("processes"))
+                    != (frag.fnum, frag.vp, jax.process_count())
+                ):
+                    # reshard-on-loss: the snapshot was written by a
+                    # different mesh (a lost rank, a changed fnum);
+                    # gather the surviving shard files and scatter the
+                    # carry onto THIS mesh's layout
+                    from libgrape_lite_tpu.ft.distributed import (
+                        restore_resharded,
+                    )
+
+                    restored, resume_meta = restore_resharded(
+                        checkpoint_dir, frag, fingerprint,
+                        base_state={
+                            k: v for k, v in state_np.items()
+                            if k not in eph
+                        },
+                    )
+                else:
+                    restored, resume_meta = restore_latest(
+                        checkpoint_dir, fingerprint
+                    )
                 carry_keys = {k for k in state_np if k not in eph}
                 if set(restored) != carry_keys:
                     raise CheckpointMismatchError(
@@ -1870,7 +1885,23 @@ class Worker:
                     checkpoint_every = (
                         resume_meta.get("checkpoint_every") or None
                     )
-            if checkpoint_every is not None:
+            if checkpoint_every is not None and distributed:
+                # the carry spans non-addressable devices: each process
+                # writes only its local shards, committed under the
+                # two-phase barrier (ft/distributed.py)
+                from libgrape_lite_tpu.ft.distributed import (
+                    ShardedCheckpointManager,
+                )
+
+                ckpt = ShardedCheckpointManager(
+                    checkpoint_dir,
+                    fingerprint=fingerprint,
+                    query_args=canonical_query_args(query_args),
+                    checkpoint_every=checkpoint_every,
+                    frag=frag,
+                    fresh_start=not _resume,
+                )
+            elif checkpoint_every is not None:
                 ckpt = CheckpointManager(
                     checkpoint_dir,
                     fingerprint=fingerprint,
@@ -1947,6 +1978,38 @@ class Worker:
                     "at every mutation boundary",
                 )
 
+        # cross-rank breach vote (guard/vote.py): armed only under
+        # jax.distributed AND only when a hazard hook exists — guard,
+        # checkpointing, or an injected fault plan, all of which are
+        # env/flag-symmetric across the gang.  Single-process `vote`
+        # stays None and voted_hooks degenerates to a plain call, so
+        # this path's behavior is bit-identical to the pre-vote code.
+        vote = None
+        if jax.process_count() > 1 and (
+            monitor is not None or ckpt is not None
+            or fault_plan is not None
+        ):
+            from libgrape_lite_tpu.guard.vote import BreachVote
+
+            vote = BreachVote.for_current_process()
+
+        def voted_hooks(vote_rounds, hooks):
+            """Run one superstep boundary's host-side hazard hooks
+            (probe / snapshot / fault injection) under the breach
+            vote: every rank exchanges a verdict at this same cut, so
+            a one-rank halt (InvariantBreachError, DivergenceError,
+            InjectedFault, an IO error in a hook) halts EVERY rank
+            instead of stranding siblings in the next collective."""
+            if vote is None:
+                return hooks()
+            try:
+                out = hooks()
+            except Exception as err:
+                vote.round_vote(vote_rounds, err)  # always re-raises
+                raise  # pragma: no cover - round_vote raised already
+            vote.round_vote(vote_rounds)
+            return out
+
         # the monotone invariants compare against the carry of the LAST
         # probe (not the last round): with a probe cadence > 1 an
         # in-gap increase that settles into a new fixed point would
@@ -1996,21 +2059,28 @@ class Worker:
                 )
                 if corrupted is not None:
                     state = {**state, **self._place_state(corrupted)}
+            def peval_hooks():
+                if (
+                    monitor is not None and int(active) >= 0
+                    and monitor.due(0)
+                ):
+                    # a PEval breach has no snapshot to restore — any
+                    # non-warn verdict halts
+                    breach = monitor.check(
+                        prev_carry, carry_of(state), 0, int(active)
+                    )
+                    if breach is not None:
+                        monitor.raise_breach(breach)
+                if ckpt is not None:
+                    # a superstep-0 snapshot always exists, so a kill
+                    # at any later round has something to fall back to
+                    ckpt.save_async(carry_of(state), 0, int(active))
+                if fault_plan is not None:
+                    fault_plan.on_superstep(0, ckpt)
+
+            voted_hooks(0, peval_hooks)
             if monitor is not None and int(active) >= 0 and monitor.due(0):
-                # a PEval breach has no snapshot to restore — any
-                # non-warn verdict halts
-                breach = monitor.check(
-                    prev_carry, carry_of(state), 0, int(active)
-                )
-                if breach is not None:
-                    monitor.raise_breach(breach)
                 guard_prev = carry_of(state)
-            if ckpt is not None:
-                # a superstep-0 snapshot always exists, so a kill at any
-                # later round has something to fall back to
-                ckpt.save_async(carry_of(state), 0, int(active))
-            if fault_plan is not None:
-                fault_plan.on_superstep(0, ckpt)
 
         def apply_mutations_if_any(state, frag, inc_fn, rounds):
             host_state = {
@@ -2089,29 +2159,51 @@ class Worker:
                 ckpt_round = (
                     ckpt is not None and rounds % checkpoint_every == 0
                 )
+
+                def round_hooks(rounds=rounds, active=active,
+                                ckpt_round=ckpt_round):
+                    # probe / snapshot / injection for this superstep;
+                    # returns a (restored, meta) rollback payload or
+                    # None.  The rollback decision is driven by jitted
+                    # GLOBAL probes, so it is symmetric across ranks —
+                    # every rank returns the same payload and the
+                    # lockstep vote in voted_hooks holds.
+                    if (
+                        monitor is not None and int(active) >= 0
+                        and (monitor.due(rounds) or ckpt_round)
+                    ):
+                        breach = monitor.check(
+                            guard_prev, carry_of(state), rounds,
+                            int(active)
+                        )
+                        if breach is not None:
+                            if breach.action == "rollback":
+                                return monitor.rollback(breach)
+                            monitor.raise_breach(breach)
+                    if (
+                        ckpt is not None
+                        and rounds % checkpoint_every == 0
+                    ):
+                        ckpt.save_async(
+                            carry_of(state), rounds, int(active)
+                        )
+                    if fault_plan is not None:
+                        fault_plan.on_superstep(rounds, ckpt)
+                    return None
+
+                rolled = voted_hooks(rounds, round_hooks)
+                if rolled is not None:
+                    restored, meta = rolled
+                    state = {**state, **self._place_state(restored)}
+                    rounds = int(meta["rounds"])
+                    active = np.int32(meta["active"])
+                    guard_prev = carry_of(state)
+                    continue
                 if (
                     monitor is not None and int(active) >= 0
                     and (monitor.due(rounds) or ckpt_round)
                 ):
-                    breach = monitor.check(
-                        guard_prev, carry_of(state), rounds, int(active)
-                    )
-                    if breach is not None:
-                        if breach.action == "rollback":
-                            restored, meta = monitor.rollback(breach)
-                            state = {
-                                **state, **self._place_state(restored)
-                            }
-                            rounds = int(meta["rounds"])
-                            active = np.int32(meta["active"])
-                            guard_prev = carry_of(state)
-                            continue
-                        monitor.raise_breach(breach)
                     guard_prev = carry_of(state)
-                if ckpt is not None and rounds % checkpoint_every == 0:
-                    ckpt.save_async(carry_of(state), rounds, int(active))
-                if fault_plan is not None:
-                    fault_plan.on_superstep(rounds, ckpt)
                 if has_mutations:
                     # MutationContext path (reference worker.h:211-222);
                     # never overrides a ForceTerminate vote
@@ -2252,7 +2344,23 @@ class Worker:
         """Per-vertex assembled values, [fnum, vp] numpy."""
         if self._result_state is None:
             raise RuntimeError("query() first")
-        host_state = jax.device_get(self._result_state)
+        if jax.process_count() > 1:
+            # the carry spans non-addressable devices in a
+            # jax.distributed run; gather each sharded leaf to a full
+            # host copy so finalize sees the same [fnum, vp] view a
+            # single-process run would
+            from jax.experimental import multihost_utils
+
+            host_state = {}
+            for k, v in self._result_state.items():
+                if getattr(v, "is_fully_addressable", True):
+                    host_state[k] = np.asarray(jax.device_get(v))
+                else:
+                    host_state[k] = np.asarray(
+                        multihost_utils.process_allgather(v)
+                    )
+        else:
+            host_state = jax.device_get(self._result_state)
         return self.app.finalize(self.fragment, host_state)
 
     def output(self, prefix: str) -> None:
@@ -2260,6 +2368,11 @@ class Worker:
         `oid value` lines (reference `GetResultFilename` + ctx Output)."""
         import os
 
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            # every process holds the full gathered result
+            # (result_values); one writer keeps a shared output dir
+            # race-free
+            return
         os.makedirs(prefix, exist_ok=True)
         values = self.result_values()
         fmt = self.app.result_format

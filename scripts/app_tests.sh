@@ -146,6 +146,29 @@ echo "== flight-recorder drill (fleet breach -> bundle byte-matches trace) =="
 # must byte-match the Chrome trace's rows for the same query ids
 python scripts/fault_drill.py --postmortem
 
+echo "== distributed resilience (2-proc gang: sharded 2PC + kill_rank + reshard) =="
+# docs/FAULT_TOLERANCE.md "Distributed resilience", through the real
+# CLI: (1) the 2-process jax.distributed dryrun, now growing a
+# checkpointed query lane that commits per-rank shard files under the
+# two-phase barrier; (2) the kill_rank drill — rank 1 of 2 dies at
+# superstep 4, and the survivors' fnum-4 sharded snapshot is
+# reshard-restored onto a single-process fnum-2 mesh, byte-identical
+# to a fault-free run (the drill exits 2 on divergence); the emitted
+# ft_drill record must pass the bench schema gate
+timeout 600 python scripts/multihost_dryrun.py > "$OUT/dryrun.txt" \
+  || { cat "$OUT/dryrun.txt"; exit 1; }
+grep -q "sharded ckpt" "$OUT/dryrun.txt" \
+  || { echo "DRYRUN CHECKPOINT LANE MISSING" >&2; cat "$OUT/dryrun.txt"; exit 1; }
+python scripts/fault_drill.py --kill_rank --workdir "$OUT/killrank" \
+  > "$OUT/killrank.txt" \
+  || { DRILL_RC=$?; cat "$OUT/killrank.txt";
+       echo "KILL_RANK DRILL FAILED (rc=$DRILL_RC)" >&2; exit $DRILL_RC; }
+cat "$OUT/killrank.txt"
+grep '"ft_drill"' "$OUT/killrank.txt" | tail -1 > "$OUT/ft_drill.json"
+python scripts/check_bench_schema.py "$OUT/ft_drill.json"
+rm -rf "$OUT/killrank"
+echo "  OK (dryrun ckpt lane, kill_rank reshard byte-identical, schema'd record)"
+
 echo "== obs trace + per-superstep report (stepwise SSSP, fnum=2) =="
 run 2 sssp --sssp_source=6 --profile \
   --trace "$OUT/trace.json" --metrics "$OUT/metrics"

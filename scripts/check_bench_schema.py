@@ -359,6 +359,23 @@ _CALIB_SURFACE = {
     "drift_pct": (_NUM, True),
 }
 
+# the distributed resilience drill (scripts/fault_drill.py
+# --kill_rank, docs/FAULT_TOLERANCE.md "Distributed resilience"): a
+# 2-process gang loses a rank at kill_round, and the survivors'
+# sharded two-phase snapshot is reshard-restored onto a smaller mesh;
+# byte_identical is the drill's verdict (the drill itself exits 2 on
+# divergence — this block makes the record auditable after the fact)
+_FT_DRILL = {
+    "ranks": (int, True),
+    "kill_round": (int, True),
+    "kill_rank": (int, True),
+    "old_fnum": (int, True),
+    "new_fnum": (int, True),
+    "checkpoint_rounds": (int, True),
+    "restore_wall_s": (_NUM, True),
+    "byte_identical": (bool, True),
+}
+
 #: every nested block bench.py may emit — THE single declaration
 #: point; _TOP, SCHEMA, validate_record and the CLI listing all
 #: derive from it (self_check() pins the derivation)
@@ -377,6 +394,7 @@ _BLOCKS = {
     "telemetry": _TELEMETRY,
     "autopilot": _AUTOPILOT,
     "calibration": _CALIBRATION,
+    "ft_drill": _FT_DRILL,
 }
 
 _TOP = {**_TOP_SCALARS, **{k: (dict, False) for k in _BLOCKS}}

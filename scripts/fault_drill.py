@@ -49,12 +49,29 @@ recorder.py), end-to-end through the real `serve` CLI under the fleet:
      query id (bundles copy tracer history verbatim — any drift in
      the export form is a correlation bug).
 
+**kill_rank** (`--kill_rank`, default app: sssp) — the distributed
+resilience drill (docs/FAULT_TOLERANCE.md, "Distributed resilience"):
+
+  1. **reference** — a fault-free single-process run on the REDUCED
+     fnum-2 mesh the survivors will restore onto.
+  2. **gang** — a 2-process `jax.distributed` gang runs the query at
+     fnum 4 with sharded two-phase checkpoints
+     (`ckpt_<K>/rank_<r>.npz`); `GRAPE_FT_FAULTS=kill_rank@K:1` kills
+     rank 1 right after superstep K's commit is durable, stranding
+     rank 0 in the next collective (genuine process loss).
+  3. **reshard restore** — a single survivor process resumes the
+     4-shard snapshot onto fnum 2 (`restore_resharded`).
+  4. **verify** — the resumed output must be byte-identical to the
+     fault-free run; a schema'd `ft_drill` JSON record is emitted
+     (scripts/check_bench_schema.py).  Exit 2 iff results diverge.
+
 Exit code 0 iff every app passes.  Usage:
 
     python scripts/fault_drill.py                 # kill/resume, 3 apps
     python scripts/fault_drill.py --apps sssp --corrupt
     python scripts/fault_drill.py --self-heal     # guard rollback drill
     python scripts/fault_drill.py --postmortem    # flight-recorder drill
+    python scripts/fault_drill.py --kill_rank     # distributed reshard drill
 """
 
 from __future__ import annotations
@@ -328,6 +345,153 @@ def postmortem_drill(args, workdir: str) -> bool:
     return True
 
 
+def kill_rank_drill(app: str, args, workdir: str) -> int:
+    """Distributed resilience drill (docs/FAULT_TOLERANCE.md): a
+    2-process gang runs the query at fnum 4 with sharded two-phase
+    checkpoints, rank 1 is killed at superstep K, and the survivors'
+    snapshot is restored onto a *smaller* single-process fnum-2 mesh
+    (reshard-on-loss).  The resumed output must be byte-identical to a
+    fault-free run on that reduced mesh.  Returns 0 on pass, 2 on
+    result divergence, 1 on any other failure."""
+    import json
+    import socket
+    import time
+
+    from libgrape_lite_tpu.ft.checkpoint import list_checkpoints, read_meta
+    from libgrape_lite_tpu.ft.faults import DEFAULT_KILL_EXIT_CODE
+
+    wd = os.path.join(workdir, f"killrank_{app}")
+    os.makedirs(wd, exist_ok=True)
+    common = [
+        "--application", app,
+        "--efile", args.efile, "--vfile", args.vfile,
+        "--platform", "cpu", "--cpu_devices", "2",
+        "--checkpoint_every", str(args.checkpoint_every),
+    ] + APP_FLAGS.get(app, [])
+
+    # 1. fault-free reference on the REDUCED mesh the survivors will
+    # restore onto (fnum 2, single process)
+    out_ref = os.path.join(wd, "out_ref")
+    rc, log = run_cli(common + [
+        "--fnum", "2",
+        "--checkpoint_dir", os.path.join(wd, "ck_ref"),
+        "--out_prefix", out_ref,
+    ])
+    if rc != 0:
+        print(f"[{app}] FAIL: fnum-2 reference run rc={rc}\n{log}")
+        return 1
+
+    # 2. 2-process gang at fnum 4 (2 local CPU devices each), sharded
+    # checkpoints, rank 1 killed at superstep K right after the
+    # two-phase commit is durable
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    ck = os.path.join(wd, "ck")
+    env = dict(os.environ)
+    env.pop("GRAPE_GUARD", None)
+    env.pop("GRAPE_POSTMORTEM", None)
+    env["GRAPE_FT_FAULTS"] = f"kill_rank@{args.kill_at}:1"
+    gang_flags = common + [
+        "--fnum", "4", "--checkpoint_dir", ck,
+        "--out_prefix", os.path.join(wd, "out_gang"),
+        "--coordinator", coord, "--num_processes", "2",
+    ]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "libgrape_lite_tpu.cli"]
+            + gang_flags + ["--process_id", str(r)],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for r in range(2)
+    ]
+    try:
+        out1, _ = procs[1].communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+        out1, _ = procs[1].communicate()
+        print(f"[{app}] FAIL: killed rank never exited\n"
+              f"{out1.decode(errors='replace')}")
+        procs[0].communicate()
+        return 1
+    # rank 0 is stranded in the next collective once its sibling is
+    # gone — that IS the loss scenario; the gang dies together
+    time.sleep(1.0)
+    if procs[0].poll() is None:
+        procs[0].kill()
+    out0, _ = procs[0].communicate()
+    if procs[1].returncode != DEFAULT_KILL_EXIT_CODE:
+        print(
+            f"[{app}] FAIL: killed rank rc={procs[1].returncode} "
+            f"(expected {DEFAULT_KILL_EXIT_CODE})\n"
+            f"--- rank 0 ---\n{out0.decode(errors='replace')}\n"
+            f"--- rank 1 ---\n{out1.decode(errors='replace')}"
+        )
+        return 1
+    steps = list_checkpoints(ck)
+    if not steps:
+        print(f"[{app}] FAIL: gang left no complete sharded checkpoint\n"
+              f"--- rank 0 ---\n{out0.decode(errors='replace')}\n"
+              f"--- rank 1 ---\n{out1.decode(errors='replace')}")
+        return 1
+    meta = read_meta(steps[-1][1])
+    if meta.get("layout") != "sharded" or meta.get("ranks") != 2:
+        print(f"[{app}] FAIL: newest checkpoint is not a 2-rank "
+              f"sharded snapshot: layout={meta.get('layout')!r} "
+              f"ranks={meta.get('ranks')!r}")
+        return 1
+    if int(meta["rounds"]) != args.kill_at:
+        print(f"[{app}] FAIL: newest durable snapshot is superstep "
+              f"{meta['rounds']}, expected the kill round "
+              f"{args.kill_at} (kill fires after commit)")
+        return 1
+
+    # 3. reshard restore: single survivor process resumes the 4-shard
+    # snapshot onto fnum 2
+    out_res = os.path.join(wd, "out_res")
+    t0 = time.monotonic()
+    rc, log = run_cli(common + [
+        "--fnum", "2", "--resume", "--checkpoint_dir", ck,
+        "--out_prefix", out_res,
+    ])
+    wall = time.monotonic() - t0
+    if rc != 0:
+        print(f"[{app}] FAIL: reshard resume rc={rc}\n{log}")
+        return 1
+    if "resharded checkpoint" not in log:
+        print(f"[{app}] FAIL: resume did not go through the reshard "
+              f"path\n{log}")
+        return 1
+
+    # 4. verify byte-identity + emit the schema'd ft_drill record
+    problems = compare_outputs(out_ref, out_res)
+    rec = {
+        "metric": "ft_drill_restore_wall",
+        "value": round(wall, 3), "unit": "s", "vs_baseline": 1.0,
+        "ft_drill": {
+            "ranks": 2, "kill_round": args.kill_at, "kill_rank": 1,
+            "old_fnum": 4, "new_fnum": 2,
+            "checkpoint_rounds": int(meta["rounds"]),
+            "restore_wall_s": round(wall, 3),
+            "byte_identical": not problems,
+        },
+    }
+    print(json.dumps(rec))
+    if problems:
+        print(f"[{app}] FAIL: " + "; ".join(problems))
+        return 2
+    print(
+        f"[{app}] PASS: rank 1 of 2 killed at superstep "
+        f"{args.kill_at}; survivors' {meta['fnum']}-shard snapshot "
+        f"resharded onto fnum 2 and resumed byte-identical to the "
+        f"fault-free run ({wall:.1f}s restore wall)"
+    )
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--apps", default="",
@@ -357,28 +521,43 @@ def main() -> int:
                         "GRAPE_POSTMORTEM sink and verify the dumped "
                         "bundle's serve_query rows byte-match the "
                         "Chrome trace")
+    p.add_argument("--kill_rank", action="store_true",
+                   help="distributed resilience drill: 2-process gang "
+                        "at fnum 4 with sharded two-phase checkpoints, "
+                        "rank 1 killed at --kill_at, survivors' "
+                        "snapshot reshard-restored onto a "
+                        "single-process fnum-2 mesh (default app: "
+                        "sssp; exit 2 iff the resumed result diverges)")
     p.add_argument("--workdir", default="",
                    help="working directory (default: a fresh temp dir, "
                         "removed on success)")
     args = p.parse_args()
 
     if not args.apps:
-        args.apps = "sssp,pagerank,wcc" if args.self_heal \
-            else "sssp,pagerank,cdlp"
+        if args.kill_rank:
+            args.apps = "sssp"
+        elif args.self_heal:
+            args.apps = "sssp,pagerank,wcc"
+        else:
+            args.apps = "sssp,pagerank,cdlp"
     workdir = args.workdir or tempfile.mkdtemp(prefix="grape-fault-drill-")
+    rc = 0
     if args.postmortem:
-        ok = postmortem_drill(args, workdir)
+        rc = 0 if postmortem_drill(args, workdir) else 1
+    elif args.kill_rank:
+        for app in filter(None, args.apps.split(",")):
+            rc = max(rc, kill_rank_drill(app.strip(), args, workdir))
     else:
         run_one = self_heal_drill if args.self_heal else drill
-        ok = True
         for app in filter(None, args.apps.split(",")):
-            ok = run_one(app.strip(), args, workdir) and ok
-    if ok and not args.workdir:
+            if not run_one(app.strip(), args, workdir):
+                rc = 1
+    if rc == 0 and not args.workdir:
         shutil.rmtree(workdir, ignore_errors=True)
     else:
         print(f"artifacts kept under {workdir}")
-    print("fault_drill:", "PASS" if ok else "FAIL")
-    return 0 if ok else 1
+    print("fault_drill:", "PASS" if rc == 0 else "FAIL")
+    return rc
 
 
 if __name__ == "__main__":

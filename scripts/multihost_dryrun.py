@@ -140,9 +140,39 @@ def worker(pid: int, coord: str) -> None:
             checked += 1
     assert checked > 0
 
+    # ---- checkpointed query lane (docs/FAULT_TOLERANCE.md,
+    # "Distributed resilience"): each process writes only its own
+    # rank_<r>.npz shards under the two-phase commit barrier, then
+    # both verify the committed snapshot's manifest ----
+    ckpt_dir = os.environ.get("GRAPE_DRYRUN_CKPT_DIR", "")
+    ckpt_note = ""
+    if ckpt_dir:
+        from libgrape_lite_tpu.ft.checkpoint import (
+            list_checkpoints, read_meta,
+        )
+        from libgrape_lite_tpu.models import SSSP
+
+        swk = Worker(SSSP(), frag)
+        swk.query_stepwise(
+            checkpoint_every=2, checkpoint_dir=ckpt_dir, source=6
+        )
+        steps = list_checkpoints(ckpt_dir)
+        assert steps, f"no committed checkpoint in {ckpt_dir}"
+        newest = steps[-1][1]
+        meta = read_meta(newest)
+        assert meta.get("layout") == "sharded", meta.get("layout")
+        assert meta.get("ranks") == NPROC, meta
+        for r in range(NPROC):
+            shard = os.path.join(newest, f"rank_{r}.npz")
+            assert os.path.exists(shard), f"missing {shard}"
+        ckpt_note = (
+            f", sharded ckpt rounds={meta['rounds']} ranks={meta['ranks']}"
+        )
+
     print(
         f"[worker {pid}] ok: fnum={fnum}, psum={got}, "
-        f"pagerank golden rows checked={checked} rounds={wk.rounds}",
+        f"pagerank golden rows checked={checked} rounds={wk.rounds}"
+        f"{ckpt_note}",
         flush=True,
     )
 
@@ -153,6 +183,7 @@ def main() -> int:
         worker(int(sys.argv[i + 1]), sys.argv[i + 2])
         return 0
 
+    import tempfile
     import time
 
     coord = f"127.0.0.1:{_free_port()}"
@@ -161,6 +192,10 @@ def main() -> int:
         env.get("XLA_FLAGS", "")
         + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}"
     ).strip()
+    # shared dir for the sharded-checkpoint lane; both workers write
+    # their rank shards here and verify the committed manifest
+    ckpt_tmp = tempfile.TemporaryDirectory(prefix="dryrun_ckpt_")
+    env["GRAPE_DRYRUN_CKPT_DIR"] = os.path.join(ckpt_tmp.name, "ck")
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.abspath(__file__),

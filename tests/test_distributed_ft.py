@@ -1,0 +1,455 @@
+"""Distributed resilience tests (ft/distributed.py + guard/vote.py):
+sharded two-phase checkpoints, cross-rank breach votes, and the
+reshard-on-loss restore.
+
+The fast lane runs the whole protocol in one process — `_HostComm` and
+`BreachVote` take injectable allgathers, and a solo (nprocs=1) comm
+makes the two-phase commit byte-exercisable without a gang.  The slow
+lane spawns real 2-process `jax.distributed` gangs through the CLI
+(the multihost_dryrun pattern) and the `fault_drill --kill_rank`
+acceptance drill."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _solo_comm():
+    from libgrape_lite_tpu.ft.distributed import _HostComm
+
+    return _HostComm(
+        rank=0, nprocs=1, allgather=lambda v: np.asarray(v)[None]
+    )
+
+
+def _mgr(directory, frag, fingerprint=None, **kw):
+    from libgrape_lite_tpu.ft.distributed import ShardedCheckpointManager
+
+    return ShardedCheckpointManager(
+        str(directory),
+        fingerprint=fingerprint or {"app": "t"},
+        query_args={},
+        checkpoint_every=2,
+        frag=frag,
+        comm=_solo_comm(),
+        **kw,
+    )
+
+
+def _state(frag):
+    rng = np.random.default_rng(0)
+    return {
+        "dist": rng.random((frag.fnum, frag.vp)).astype(np.float64),
+        "aux": np.arange(3, dtype=np.int32),  # replicated-shaped leaf
+    }
+
+
+# ---- sharded write / two-phase commit (fast, tier-1) ---------------------
+
+
+def test_sharded_commit_roundtrip(graph_cache, tmp_path):
+    """Stage + commit writes rank shard files and a sharded meta.json;
+    the sharded-aware `restore_latest` gathers the identical state."""
+    from libgrape_lite_tpu.ft.checkpoint import (
+        list_checkpoints, read_meta, restore_latest,
+    )
+    from libgrape_lite_tpu.ft.distributed import load_sharded_state
+
+    frag = graph_cache(2)
+    state = _state(frag)
+    mgr = _mgr(tmp_path / "ck", frag)
+    mgr.save_async(state, rounds=4, active=5)
+
+    steps = list_checkpoints(str(tmp_path / "ck"))
+    assert [r for r, _ in steps] == [4]
+    path = steps[-1][1]
+    meta = read_meta(path)
+    assert meta["layout"] == "sharded"
+    assert meta["ranks"] == 1
+    assert (meta["fnum"], meta["vp"]) == (frag.fnum, frag.vp)
+    assert os.path.exists(os.path.join(path, "rank_0.npz"))
+    assert os.path.exists(os.path.join(path, "rank_0.json"))
+
+    got = load_sharded_state(path, meta)
+    assert set(got) == set(state)
+    for k in state:
+        np.testing.assert_array_equal(got[k], state[k])
+
+    # the ordinary restore_latest recognises the sharded layout
+    restored, rmeta = restore_latest(str(tmp_path / "ck"), {"app": "t"})
+    assert rmeta["rounds"] == 4 and rmeta["active"] == 5
+    np.testing.assert_array_equal(restored["dist"], state["dist"])
+
+
+def test_stage_without_commit_never_adopted(graph_cache, tmp_path):
+    """A kill between the phases leaves a `.stage-*` partial: never a
+    complete checkpoint, and swept (loudly) on the next manager
+    construction."""
+    from libgrape_lite_tpu.ft.checkpoint import list_checkpoints
+
+    frag = graph_cache(2)
+    mgr = _mgr(tmp_path / "ck", frag)
+    stage = str(tmp_path / "ck" / ".stage-00000004")
+    os.makedirs(stage)
+    mgr._stage_local(_state(frag), 4, 5, stage)
+    # staged but uncommitted: no meta.json, not a checkpoint
+    assert not os.path.exists(os.path.join(stage, "meta.json"))
+    assert list_checkpoints(str(tmp_path / "ck")) == []
+
+    _mgr(tmp_path / "ck", frag)  # construction sweeps the partial
+    assert not os.path.exists(stage)
+
+
+def test_commit_refuses_corrupted_stage(graph_cache, tmp_path):
+    """The commit phase re-hashes every staged shard against the vote:
+    bytes flipped between stage and commit fail the quorum check."""
+    from libgrape_lite_tpu.ft.checkpoint import CorruptCheckpointError
+    from libgrape_lite_tpu.ft.distributed import _sha_prefix
+
+    frag = graph_cache(2)
+    mgr = _mgr(tmp_path / "ck", frag)
+    stage = str(tmp_path / "ck" / ".stage-00000004")
+    os.makedirs(stage)
+    sha, _ = mgr._stage_local(_state(frag), 4, 5, stage)
+    npz = os.path.join(stage, "rank_0.npz")
+    blob = bytearray(open(npz, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(npz, "wb").write(bytes(blob))
+
+    lo, hi = _sha_prefix(sha)
+    votes = np.asarray([[1, 4, lo, hi]], np.int32)
+    with pytest.raises(CorruptCheckpointError, match="refusing to commit"):
+        mgr._commit(stage, 4, 5, votes)
+    # nothing was adopted
+    assert not os.path.exists(str(tmp_path / "ck" / "ckpt_00000004"))
+
+
+def test_stage_failure_fails_every_rank(graph_cache, tmp_path):
+    """A rank voting stage-failed turns into a gang-wide
+    CorruptCheckpointError at the first barrier (nobody commits)."""
+    from libgrape_lite_tpu.ft.checkpoint import CorruptCheckpointError
+    from libgrape_lite_tpu.ft.distributed import (
+        _HostComm, ShardedCheckpointManager,
+    )
+
+    frag = graph_cache(2)
+
+    # this rank stages fine, but the allgather reports rank 1 failed
+    # (first element of the vote vector is the ok flag; the barrier's
+    # zeros(1) vector passes through unchanged)
+    def allgather(vec):
+        v = np.asarray(vec, np.int32)
+        peer = v.copy()
+        peer[0] = 0
+        return np.stack([v, peer])
+
+    mgr = ShardedCheckpointManager(
+        str(tmp_path / "ck"), fingerprint={"app": "t"}, query_args={},
+        checkpoint_every=2, frag=frag,
+        comm=_HostComm(rank=0, nprocs=2, allgather=allgather),
+    )
+    with pytest.raises(CorruptCheckpointError, match=r"rank\(s\) \[1\]"):
+        mgr.save_async(_state(frag), rounds=2, active=3)
+
+
+# ---- cross-rank breach vote (fast, tier-1) -------------------------------
+
+
+def _vote(responses, rank=0, nprocs=2):
+    from libgrape_lite_tpu.guard.vote import BreachVote
+
+    return BreachVote(
+        rank=rank, nprocs=nprocs,
+        allgather=lambda v: np.asarray(responses, np.int32),
+    )
+
+
+def test_vote_unanimous_healthy_returns():
+    _vote([[0, 7], [0, 7]]).round_vote(7)  # no raise
+
+
+def test_vote_remote_breach_names_rank():
+    from libgrape_lite_tpu.guard.vote import RemoteBreachError
+
+    with pytest.raises(RemoteBreachError, match="rank 1: invariant") as ei:
+        _vote([[0, 7], [1, 7]]).round_vote(7)
+    assert ei.value.bundle["ranks"] == [1]
+
+
+def test_vote_local_error_reraised_after_exchange():
+    from libgrape_lite_tpu.guard.monitor import InvariantBreachError
+
+    exchanged = []
+
+    def allgather(v):
+        exchanged.append(np.asarray(v).tolist())
+        return np.asarray([[1, 7], [0, 7]], np.int32)
+
+    from libgrape_lite_tpu.guard.vote import BreachVote
+
+    vote = BreachVote(rank=0, nprocs=2, allgather=allgather)
+    err = InvariantBreachError("dist went up", {"round": 7})
+    with pytest.raises(InvariantBreachError, match="dist went up"):
+        vote.round_vote(7, err)
+    # the verdict crossed the wire BEFORE the local raise: code 1 at 7
+    assert exchanged == [[1, 7]]
+
+
+def test_vote_round_skew_is_a_halt():
+    from libgrape_lite_tpu.guard.vote import RemoteBreachError
+
+    with pytest.raises(RemoteBreachError, match="out of lockstep"):
+        _vote([[0, 6], [0, 7]]).round_vote(6)
+
+
+def test_vote_classifies_guard_errors():
+    from libgrape_lite_tpu.ft.faults import InjectedFault
+    from libgrape_lite_tpu.guard.monitor import (
+        DivergenceError, InvariantBreachError,
+    )
+    from libgrape_lite_tpu.guard.vote import (
+        VOTE_DIVERGENCE, VOTE_ERROR, VOTE_FAULT, VOTE_HEALTHY,
+        VOTE_INVARIANT, classify_breach_error,
+    )
+
+    assert classify_breach_error(None) == VOTE_HEALTHY
+    assert classify_breach_error(
+        InvariantBreachError("b", {})) == VOTE_INVARIANT
+    assert classify_breach_error(
+        DivergenceError("d", {})) == VOTE_DIVERGENCE
+    assert classify_breach_error(InjectedFault("k")) == VOTE_FAULT
+    assert classify_breach_error(OSError("io")) == VOTE_ERROR
+
+
+# ---- reshard-on-loss restore (fast, tier-1) ------------------------------
+
+
+def _sharded_snapshot_from(kill_dir, shard_dir, frag, app, query_args):
+    """Re-save the newest single-file checkpoint as a sharded snapshot
+    (what a real gang writes) so the reshard path is exercisable
+    in-process."""
+    from libgrape_lite_tpu.ft.checkpoint import (
+        list_checkpoints, load_state, read_meta,
+    )
+    from libgrape_lite_tpu.ft.fingerprint import (
+        canonical_query_args, compute_fingerprint,
+    )
+
+    steps = list_checkpoints(str(kill_dir))
+    assert steps, "kill left no checkpoint to reshard from"
+    rounds, path = steps[-1]
+    meta = read_meta(path)
+    state = load_state(path, meta)
+    mgr = _mgr(
+        shard_dir, frag,
+        fingerprint=compute_fingerprint(app, frag, query_args),
+    )
+    mgr.query_args = canonical_query_args(query_args)
+    mgr.checkpoint_every = int(meta["checkpoint_every"])
+    mgr.save_async(state, int(meta["rounds"]), int(meta["active"]))
+    return rounds
+
+
+def test_reshard_restore_fnum4_to_2_byte_identical(graph_cache, tmp_path):
+    """The acceptance contract: a fnum-4 snapshot killed at superstep
+    4 restores onto a fnum-2 mesh and finishes byte-identical to a
+    cold fnum-2 run (SSSP's min-fold carry is partition-independent)."""
+    from libgrape_lite_tpu.ft.faults import FaultPlan, InjectedFault
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag4, frag2 = graph_cache(4), graph_cache(2)
+
+    w_ref = Worker(SSSP(), frag2)
+    w_ref.query(source=6)
+    ref = w_ref.result_values()
+
+    kill_dir = tmp_path / "kill"
+    with pytest.raises(InjectedFault):
+        Worker(SSSP(), frag4).query(
+            checkpoint_every=2, checkpoint_dir=str(kill_dir),
+            fault_plan=FaultPlan(kill_at_superstep=4, mode="raise"),
+            source=6,
+        )
+    shard_dir = tmp_path / "shard"
+    _sharded_snapshot_from(
+        kill_dir, shard_dir, frag4, SSSP(), {"source": 6}
+    )
+
+    w_res = Worker(SSSP(), frag2)
+    w_res.resume(str(shard_dir))
+    res = w_res.result_values()
+    assert res.tobytes() == ref.tobytes()
+    assert w_res.rounds > 4  # it resumed mid-query, not from scratch
+
+
+def test_reshard_rejects_single_file_layout(graph_cache, tmp_path):
+    """A single-process snapshot has no shard files or vertex maps: a
+    reshard attempt must be a loud mismatch, not a guess."""
+    from libgrape_lite_tpu.ft.checkpoint import CheckpointMismatchError
+    from libgrape_lite_tpu.ft.distributed import restore_resharded
+    from libgrape_lite_tpu.ft.fingerprint import compute_fingerprint
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag2, frag4 = graph_cache(2), graph_cache(4)
+    d = str(tmp_path / "ck")
+    Worker(SSSP(), frag4).query(
+        checkpoint_every=3, checkpoint_dir=d, source=6
+    )
+    with pytest.raises(CheckpointMismatchError, match="original mesh"):
+        restore_resharded(
+            d, frag2, compute_fingerprint(SSSP(), frag2, {"source": 6}),
+            base_state={"dist": np.zeros((frag2.fnum, frag2.vp))},
+        )
+
+
+def test_reshard_rejects_different_graph(graph_cache, tmp_path):
+    """Identical vertex universes or bust: dropping a shard's oids
+    must read as 'different graph', never silently resume."""
+    from libgrape_lite_tpu.ft.checkpoint import CheckpointMismatchError
+    from libgrape_lite_tpu.ft.distributed import (
+        _CheckpointLayout, restore_resharded,
+    )
+
+    frag = graph_cache(2)
+    mgr = _mgr(tmp_path / "ck", frag, fingerprint={"app": "t"})
+    mgr.save_async(_state(frag), rounds=2, active=3)
+
+    class Shrunk:
+        fnum = frag.fnum
+        vp = frag.vp
+
+        @staticmethod
+        def inner_oids(f):
+            oids = np.asarray(frag.inner_oids(f), np.int64)
+            return oids[:-1] if f == 0 else oids  # drop one vertex
+
+        oid_to_pid = staticmethod(frag.oid_to_pid)
+
+    with pytest.raises(CheckpointMismatchError, match="universes differ"):
+        restore_resharded(
+            str(tmp_path / "ck"), Shrunk, {"app": "t"},
+            base_state=_state(frag),
+        )
+    # sanity: the layout stand-in resolves oids like a fragment
+    from libgrape_lite_tpu.ft.checkpoint import list_checkpoints, read_meta
+    from libgrape_lite_tpu.ft.distributed import load_shard_layout
+
+    path = list_checkpoints(str(tmp_path / "ck"))[-1][1]
+    layout = _CheckpointLayout(
+        frag.fnum, frag.vp, load_shard_layout(path, read_meta(path))
+    )
+    oids = np.asarray(frag.inner_oids(0), np.int64)[:5]
+    np.testing.assert_array_equal(
+        layout.oid_to_pid(oids), np.asarray(frag.oid_to_pid(oids))
+    )
+    assert int(layout.oid_to_pid(np.asarray([10 ** 12]))[0]) == -1
+
+
+def test_partition_mode_in_fingerprint_blocks_mismatched_restore(
+    graph_cache, tmp_path, monkeypatch
+):
+    """The satellite bugfix: a snapshot written under the default 1-D
+    partition must never silently restore into a 2-D worker — the
+    fingerprint now carries partition_mode and mismatches loudly."""
+    from libgrape_lite_tpu.ft.checkpoint import CheckpointMismatchError
+    from libgrape_lite_tpu.ft.fingerprint import compute_fingerprint
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    frag = graph_cache(2)
+    monkeypatch.delenv("GRAPE_PARTITION", raising=False)
+    fp_1d = compute_fingerprint(SSSP(), frag, {"source": 6})
+    assert fp_1d["partition_mode"] == "1d"
+    assert fp_1d["processes"] == 1
+
+    d = str(tmp_path / "ck")
+    Worker(SSSP(), frag).query(
+        checkpoint_every=3, checkpoint_dir=d, source=6
+    )
+    monkeypatch.setenv("GRAPE_PARTITION", "2d")
+    w = Worker(SSSP(), frag)
+    with pytest.raises(CheckpointMismatchError, match="partition_mode"):
+        w.resume(d)
+
+
+# ---- 2-process subprocess lanes (slow) -----------------------------------
+
+
+def _clean_env():
+    return {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+
+
+@pytest.mark.slow
+def test_kill_rank_reshard_drill():
+    """The acceptance drill end-to-end: 2-process gang, rank 1 killed
+    at superstep 4, survivors reshard-restored onto fnum 2, output
+    byte-identical (fault_drill exits 2 on divergence)."""
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "fault_drill.py"), "--kill_rank"],
+        capture_output=True, timeout=570, text=True, env=_clean_env(),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    rec = json.loads(
+        [l for l in r.stdout.splitlines() if '"ft_drill"' in l][-1]
+    )
+    assert rec["ft_drill"]["byte_identical"] is True
+    assert rec["ft_drill"]["ranks"] == 2
+
+
+@pytest.mark.slow
+def test_vote_quorum_halt_two_process(tmp_path):
+    """A one-rank InjectedFault (mode=raise) under a live 2-process
+    gang halts BOTH ranks at the same superstep: the breaching rank
+    with InjectedFault, the healthy one with RemoteBreachError —
+    nobody is left hanging in a collective."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    env = _clean_env()
+    env["GRAPE_FT_FAULTS"] = "kill_rank@2:1,mode=raise"
+    flags = [
+        "--application", "sssp", "--sssp_source", "6",
+        "--efile", os.path.join(REPO, "dataset", "p2p-31.e"),
+        "--vfile", os.path.join(REPO, "dataset", "p2p-31.v"),
+        "--platform", "cpu", "--cpu_devices", "2", "--fnum", "4",
+        "--checkpoint_every", "2",
+        "--checkpoint_dir", str(tmp_path / "ck"),
+        "--out_prefix", str(tmp_path / "out"),
+        "--coordinator", coord, "--num_processes", "2",
+    ]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "libgrape_lite_tpu.cli"]
+            + flags + ["--process_id", str(i)],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    assert procs[0].returncode not in (0, None), outs[0]
+    assert procs[1].returncode not in (0, None), outs[1]
+    # the healthy rank names the voted halt; the faulty one its fault
+    assert "halt voted at superstep 2" in outs[0], outs[0]
+    assert "injected kill of rank 1 at superstep 2" in outs[1], outs[1]

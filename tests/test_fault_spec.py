@@ -95,3 +95,56 @@ def test_corrupt_carry_int_leaf_goes_negative():
     p = FaultPlan(corrupt_carry_at=0)
     out = p.maybe_corrupt_carry({"comp": np.zeros((2, 8), np.int32)}, 0)
     assert out is not None and (out["comp"] < 0).any()
+
+
+def test_kill_rank_spec_parses():
+    p = FaultPlan.from_spec("kill_rank@4:1")
+    assert (p.kill_rank_at, p.kill_rank) == (4, 1)
+    # not swallowed by the kill@ prefix (longest-prefix-first)
+    assert p.kill_at_superstep is None
+    assert not p.is_noop()
+    assert p.exit_code == DEFAULT_KILL_EXIT_CODE
+
+
+@pytest.mark.parametrize("spec", [
+    "kill_rank@4",      # missing :R
+    "kill_rank@x:1",    # malformed superstep
+    "kill_rank@1:y",    # malformed rank
+    "kill_rank@1:-2",   # negative rank
+])
+def test_bad_kill_rank_tokens_raise_typed_error(spec):
+    with pytest.raises(FaultSpecError) as ei:
+        FaultPlan.from_spec(spec)
+    assert "kill_rank@K:R" in str(ei.value)
+
+
+def test_kill_rank_fires_only_on_its_rank():
+    """Single-process jax.process_index() is 0: a rank-0 kill fires at
+    its superstep (and only there), a rank-1 kill never does — the
+    same spec arms every member of a gang and fires on exactly one."""
+    from libgrape_lite_tpu.ft.faults import InjectedFault
+
+    hit = FaultPlan(kill_rank_at=3, kill_rank=0, mode="raise")
+    hit.on_superstep(2, None)  # wrong superstep: no-op
+    with pytest.raises(InjectedFault, match="rank 0 at superstep 3"):
+        hit.on_superstep(3, None)
+
+    miss = FaultPlan(kill_rank_at=3, kill_rank=1, mode="raise")
+    miss.on_superstep(3, None)  # another rank's kill: no-op here
+
+
+def test_kill_rank_waits_for_durable_checkpoint():
+    """Like kill@: the injected loss must not race the in-flight
+    snapshot — the manager is drained before the kill."""
+    from libgrape_lite_tpu.ft.faults import InjectedFault
+
+    waited = []
+
+    class Mgr:
+        def wait(self):
+            waited.append(1)
+
+    p = FaultPlan(kill_rank_at=2, kill_rank=0, mode="raise")
+    with pytest.raises(InjectedFault):
+        p.on_superstep(2, Mgr())
+    assert waited == [1]
