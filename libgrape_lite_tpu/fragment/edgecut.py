@@ -248,7 +248,10 @@ class ShardedEdgecutFragment:
         return self.dev.total_enum
 
     def inner_vertices_num(self, fid: int) -> int:
-        return int(np.asarray(self.dev.ivnum)[fid])
+        # host-side source: dev.ivnum is built from exactly this value
+        # (_device_put), but the device copy spans non-addressable
+        # devices under jax.distributed and cannot be fetched
+        return int(self.vertex_map.inner_vertex_num(fid))
 
     def is_string_keyed(self) -> bool:
         """True when vertex oids are strings (--string_id graphs)."""

@@ -530,7 +530,21 @@ def load_sharded_state(
                 )
             a = arrays[k]
             if lm.get("replicated"):
-                out.setdefault(k, a)
+                prev = out.get(k)
+                if prev is None:
+                    out[k] = a
+                elif (
+                    prev.shape != a.shape
+                    or prev.dtype != a.dtype
+                    or prev.tobytes() != a.tobytes()
+                ):
+                    # a "replicated" leaf must be byte-identical on
+                    # every rank; divergence means the gang was not in
+                    # lockstep when it staged
+                    raise CorruptCheckpointError(
+                        f"replicated leaf {k!r} diverges across shard "
+                        f"files (rank {r} copy != earlier ranks')"
+                    )
                 continue
             dst = out.setdefault(
                 k,

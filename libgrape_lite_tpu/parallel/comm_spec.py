@@ -82,8 +82,19 @@ class CommSpec:
                 jax.config.update(
                     "jax_cpu_collectives_implementation", "gloo"
                 )
-            except Exception:
-                pass  # jaxlib built without gloo: CPU gangs unsupported
+            except (AttributeError, ValueError) as e:
+                # AttributeError: the flag was renamed/removed in this
+                # jax; ValueError: jaxlib built without gloo.  Either
+                # way CPU gangs will fail later — say why now instead
+                # of swallowing it silently
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "could not select gloo CPU collectives (%s); "
+                    "multi-process CPU runs may fail with "
+                    "'Multiprocess computations aren't implemented on "
+                    "the CPU backend'", e,
+                )
             from libgrape_lite_tpu.ft.retry import (
                 DISTRIBUTED_INIT_POLICY,
                 is_late_init_error,

@@ -1851,12 +1851,17 @@ class Worker:
             if _resume:
                 meta0 = latest_meta(checkpoint_dir)
                 fp0 = meta0.get("fingerprint", {})
-                if meta0.get("layout") == "sharded" and (
-                    (fp0.get("fnum"), fp0.get("vp"), fp0.get("processes"))
-                    != (frag.fnum, frag.vp, jax.process_count())
+                from libgrape_lite_tpu.ft.distributed import (
+                    GEOMETRY_KEYS,
+                )
+
+                if meta0.get("layout") == "sharded" and any(
+                    fp0.get(k) != fingerprint.get(k)
+                    for k in GEOMETRY_KEYS
                 ):
                     # reshard-on-loss: the snapshot was written by a
-                    # different mesh (a lost rank, a changed fnum);
+                    # different mesh — a lost rank, a changed fnum, or
+                    # the same shape cut differently (fragment_hash);
                     # gather the surviving shard files and scatter the
                     # carry onto THIS mesh's layout
                     from libgrape_lite_tpu.ft.distributed import (
@@ -2368,13 +2373,15 @@ class Worker:
         `oid value` lines (reference `GetResultFilename` + ctx Output)."""
         import os
 
+        # result_values() runs a process_allgather on non-fully-
+        # addressable leaves — a collective EVERY process must join, so
+        # all ranks gather before the single-writer early return below
+        values = self.result_values()
         if jax.process_count() > 1 and jax.process_index() != 0:
-            # every process holds the full gathered result
-            # (result_values); one writer keeps a shared output dir
-            # race-free
+            # every process now holds the full gathered result; one
+            # writer keeps a shared output dir race-free
             return
         os.makedirs(prefix, exist_ok=True)
-        values = self.result_values()
         fmt = self.app.result_format
         for f in range(self.fragment.fnum):
             n = self.fragment.inner_vertices_num(f)

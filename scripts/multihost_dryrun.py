@@ -165,8 +165,18 @@ def worker(pid: int, coord: str) -> None:
         for r in range(NPROC):
             shard = os.path.join(newest, f"rank_{r}.npz")
             assert os.path.exists(shard), f"missing {shard}"
+        # output() on EVERY rank: the result gather inside it is a
+        # process_allgather all processes must join (a rank-0-only
+        # call deadlocks the gang); rank 0 alone then writes the files
+        out_dir = os.path.join(os.path.dirname(ckpt_dir), "out")
+        swk.output(out_dir)
+        if pid == 0:
+            for f in range(frag.fnum):
+                rf = os.path.join(out_dir, f"result_frag_{f}")
+                assert os.path.getsize(rf) > 0, f"empty {rf}"
         ckpt_note = (
             f", sharded ckpt rounds={meta['rounds']} ranks={meta['ranks']}"
+            f", output files={frag.fnum}"
         )
 
     print(

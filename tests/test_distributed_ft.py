@@ -9,6 +9,8 @@ lane spawns real 2-process `jax.distributed` gangs through the CLI
 (the multihost_dryrun pattern) and the `fault_drill --kill_rank`
 acceptance drill."""
 
+import hashlib
+import io
 import json
 import os
 import shutil
@@ -157,6 +159,54 @@ def test_stage_failure_fails_every_rank(graph_cache, tmp_path):
     )
     with pytest.raises(CorruptCheckpointError, match=r"rank\(s\) \[1\]"):
         mgr.save_async(_state(frag), rounds=2, active=3)
+
+
+def test_replicated_leaf_divergence_is_corrupt(tmp_path):
+    """A 'replicated' leaf must be byte-identical in every rank's shard
+    file; a rank-divergent copy is a CorruptCheckpointError, never a
+    silent adopt-from-lowest-rank."""
+    from libgrape_lite_tpu.ft.checkpoint import CorruptCheckpointError
+    from libgrape_lite_tpu.ft.distributed import load_sharded_state
+
+    step = tmp_path / "ckpt_00000004"
+    step.mkdir()
+    fnum, vp = 2, 3
+    dist = np.arange(fnum * vp, dtype=np.float64).reshape(fnum, vp)
+    leafmeta = {
+        "dist": {"rows": None, "shape": [fnum, vp], "dtype": "<f8"},
+        "aux": {"replicated": True, "shape": [3], "dtype": "<i4"},
+    }
+    shards = {}
+    for r in range(2):
+        aux = np.arange(3, dtype=np.int32)
+        if r == 1:
+            aux = aux + 7  # the gang was not in lockstep
+        payload = {
+            "dist": dist[r][None],
+            "aux": aux,
+            f"__oids_{r}": np.arange(vp, dtype=np.int64),
+        }
+        buf = io.BytesIO()
+        np.savez(buf, **payload)
+        blob = buf.getvalue()
+        (step / f"rank_{r}.npz").write_bytes(blob)
+        shards[str(r)] = {
+            "sha256": hashlib.sha256(blob).hexdigest(),
+            "oid_rows": [r],
+            "leaves": {
+                "dist": {**leafmeta["dist"], "rows": [r]},
+                "aux": leafmeta["aux"],
+            },
+        }
+    meta = {
+        "fnum": fnum,
+        "vp": vp,
+        "shards": shards,
+        "leaves": {k: {"shape": v["shape"], "dtype": v["dtype"]}
+                   for k, v in leafmeta.items()},
+    }
+    with pytest.raises(CorruptCheckpointError, match="diverges"):
+        load_sharded_state(str(step), meta)
 
 
 # ---- cross-rank breach vote (fast, tier-1) -------------------------------
