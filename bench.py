@@ -666,6 +666,156 @@ def partition2d_lane(scale: int) -> dict:
     }
 
 
+def vc2d_pipeline_lane(scale: int) -> dict:
+    """The pipelined-SUMMA A/B (PR 19; parallel/pipeline.py
+    VC2DPipelinePlan, models/vc2d.py inceval_pipelined): SSSP on the
+    fnum 4 (k=2) vertex-cut mesh, pipelined vs unpipelined vs the 1-D
+    edge-cut baseline, all three byte-compared per oid.
+
+    Verdicts are split HONESTLY: byte-identity and the decision
+    record (rate-profile label + modeled hidden-µs per round) are
+    hard gates; the measured wall is reported with the backend it ran
+    on — the CPU fallback dispatches collectives synchronously, so a
+    CPU wall is a correctness proxy, never overlap evidence (the
+    modeled TPU dividend is what `modeled_hidden_us` prices).
+
+    Like the pipeline lane, engagement is FORCED (the auto byte floor
+    would correctly decline a small CPU twin; that gate has its own
+    tests)."""
+    import jax
+
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.fragment.vertexcut import (
+        ImmutableVertexcutFragment,
+    )
+    from libgrape_lite_tpu.models import SSSP, SSSPVC2D
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.partitioner import (
+        SegmentedPartitioner,
+    )
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    fnum, k = 4, 2
+    if jax.device_count() < fnum:
+        raise RuntimeError("vc2d_pipeline lane needs >= 4 devices")
+    scripts = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    from gen_rmat import shuffle_perm
+
+    n, src_raw, dst_raw = rmat_edges(scale, EDGE_FACTOR)
+    perm = shuffle_perm(n)
+    src, dst = perm[src_raw], perm[dst_raw]
+    rng_w = np.random.default_rng(11)
+    w = rng_w.uniform(0.1, 10.0, size=len(src)).astype(np.float32)
+    oids = np.arange(n, dtype=np.int64)
+
+    comm = CommSpec(fnum=fnum)
+    vm = VertexMap.build(oids, SegmentedPartitioner(fnum, oids))
+    frag_1d = ShardedEdgecutFragment.build(
+        comm, vm, src, dst, w, directed=False,
+        load_strategy=LoadStrategy.kBothOutIn,
+    )
+    frag_2d = ImmutableVertexcutFragment.build(
+        comm, oids, src, dst, w, directed=False, symmetrize=True,
+    )
+
+    def assembled(worker, frag):
+        vals = worker.result_values()
+        out = np.full(n, np.nan, dtype=vals.dtype)
+        for f in range(frag.fnum):
+            m = frag.inner_vertices_num(f)
+            if m:
+                out[np.asarray(frag.inner_oids(f))] = vals[f, :m]
+        return out
+
+    def best_of(app_cls, frag, pipe: str, n_meas=3, **kw):
+        prev = os.environ.get("GRAPE_PIPELINE")
+        os.environ["GRAPE_PIPELINE"] = pipe
+        try:
+            worker = Worker(app_cls(), frag)
+            worker.query(**kw)  # warm (compile + plan)
+            best = float("inf")
+            for _ in range(n_meas):
+                t0 = time.perf_counter()
+                worker.query(**kw)
+                best = min(best, time.perf_counter() - t0)
+            return best, assembled(worker, frag), worker.app
+        finally:
+            if prev is None:
+                os.environ.pop("GRAPE_PIPELINE", None)
+            else:
+                os.environ["GRAPE_PIPELINE"] = prev
+
+    t_1d, res_1d, _ = best_of(SSSP, frag_1d, "0", source=0)
+    t_s2d, res_s2d, _ = best_of(SSSPVC2D, frag_2d, "0", source=0)
+    t_p2d, res_p2d, app = best_of(SSSPVC2D, frag_2d, "force", source=0)
+    plan = getattr(app, "_pipeline", None)
+    if plan is None:
+        from libgrape_lite_tpu.parallel.pipeline import PIPELINE_STATS
+
+        print(
+            f"[bench] vc2d_pipeline: declined under force: "
+            f"{PIPELINE_STATS['last_decision']}",
+            file=sys.stderr,
+        )
+    dec = plan.decision if plan is not None else {}
+    brief = plan.span_brief() if plan is not None else {}
+    t = plan.stats["totals"] if plan is not None else {}
+    return {
+        "scale": scale,
+        "fnum": fnum,
+        "k": k,
+        "app": "sssp",
+        "engaged": plan is not None,
+        "phase_split": int(t.get("phase_split", 0)),
+        "edge_slots": int(t.get("edge_slots", 0)),
+        "exchange_bytes": plan.exchange_bytes if plan is not None else 0,
+        "serial_1d_s": round(t_1d, 4),
+        "serial_2d_s": round(t_s2d, 4),
+        "pipelined_2d_s": round(t_p2d, 4),
+        "pipelined_eq_serial_2d": (
+            res_p2d.tobytes() == res_s2d.tobytes()
+        ),
+        "pipelined_eq_1d": res_p2d.tobytes() == res_1d.tobytes(),
+        "profile": str(dec.get("profile", "")),
+        "modeled_hidden_us": float(dec.get("modeled_hidden_us", -1.0)),
+        "modeled_hidden_frac": float(
+            brief.get("modeled_hidden_frac", 0.0)),
+        "measured_speedup": round(t_s2d / max(t_p2d, 1e-9), 4),
+        "wall_backend": str(jax.default_backend()),
+        "wall_is_overlap_evidence": jax.default_backend() == "tpu",
+    }
+
+
+def _vc2d_pipeline_lane_subprocess(scale: int) -> dict:
+    """Run the lane in a fresh CPU process with a forced 4-device host
+    platform (same pattern as the partition2d lane)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--vc2d-pipeline-lane", str(scale)],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"vc2d-pipeline-lane subprocess failed: "
+            f"{r.stderr.strip()[-500:]}"
+        )
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 # measured walls within this band of each other count as agreeing
 # with the planner's modeled choice: the model prices TPU VPU/ICI
 # rates, and a CPU-fallback split finer than this is dispatch noise
@@ -2083,6 +2233,61 @@ def main():
                 file=sys.stderr,
             )
 
+    # pipelined-SUMMA lane (PR 19): 2-D SSSP pipelined vs unpipelined
+    # vs the 1-D baseline, byte-compared per oid; the decision record
+    # must carry the rate-profile label and the modeled hidden-µs per
+    # round.  GRAPE_BENCH_NO_VC2D_PIPELINE=1 skips;
+    # GRAPE_BENCH_VC2D_PIPELINE_SCALE sizes the twin.
+    vc2dp_mismatch = None
+    if not os.environ.get("GRAPE_BENCH_NO_VC2D_PIPELINE"):
+        try:
+            vc2dp_scale = int(os.environ.get(
+                "GRAPE_BENCH_VC2D_PIPELINE_SCALE", min(SCALE, 12)))
+            if jax.device_count() >= 4:
+                vc2dp = vc2d_pipeline_lane(vc2dp_scale)
+            else:
+                vc2dp = _vc2d_pipeline_lane_subprocess(vc2dp_scale)
+            record["vc2d_pipeline"] = vc2dp
+            _emit_record(record)
+            print(
+                f"[bench] vc2d_pipeline: 1d={vc2dp['serial_1d_s']}s "
+                f"2d={vc2dp['serial_2d_s']}s "
+                f"2d-pipelined={vc2dp['pipelined_2d_s']}s "
+                f"eq_2d={vc2dp['pipelined_eq_serial_2d']} "
+                f"eq_1d={vc2dp['pipelined_eq_1d']} "
+                f"hidden_us={vc2dp['modeled_hidden_us']} "
+                f"profile={vc2dp['profile']} "
+                f"(wall on {vc2dp['wall_backend']}: "
+                + ("overlap evidence"
+                   if vc2dp["wall_is_overlap_evidence"]
+                   else "correctness proxy only — collectives are "
+                        "synchronous off-TPU") + ")",
+                file=sys.stderr,
+            )
+            for bad, why in (
+                (not vc2dp["engaged"],
+                 "lane ran FORCED but the vc2d plan did not engage — "
+                 "see the decline reason above"),
+                (not vc2dp["pipelined_eq_serial_2d"],
+                 "pipelined 2-D diverged from the unpipelined 2-D "
+                 "round"),
+                (not vc2dp["pipelined_eq_1d"],
+                 "2-D result diverged from the 1-D baseline"),
+                (not vc2dp["profile"],
+                 "decision record is missing the rate-profile label"),
+                (vc2dp["modeled_hidden_us"] < 0,
+                 "decision record is missing modeled_hidden_us"),
+            ):
+                if bad:
+                    vc2dp_mismatch = why
+                    break
+        except Exception as e:  # the lane must not cost the bench
+            print(
+                f"[bench] vc2d_pipeline lane failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+
     # masked-SpGEMM lane (r11, ROADMAP 5a): LCC intersect-vs-spgemm
     # wall A/B at GRAPE_BENCH_SPGEMM_SCALE (default min(SCALE, 10))
     # with the bit-exactness verdict + shipped-plan recount, and the
@@ -2320,6 +2525,13 @@ def main():
             file=sys.stderr,
         )
         sys.exit(2)
+    if vc2dp_mismatch is not None:
+        print(
+            f"[bench] FATAL: vc2d_pipeline lane verdict failed: "
+            f"{vc2dp_mismatch} — see the vc2d_pipeline block above",
+            file=sys.stderr,
+        )
+        sys.exit(2)
     if spgemm_mismatch is not None:
         print(
             f"[bench] FATAL: spgemm lane verdict failed: "
@@ -2377,5 +2589,9 @@ if __name__ == "__main__":
         # subprocess entrypoint for the 1-D vs 2-D partition A/B
         _i = sys.argv.index("--partition2d-lane")
         print(json.dumps(partition2d_lane(int(sys.argv[_i + 1]))))
+    elif "--vc2d-pipeline-lane" in sys.argv:
+        # subprocess entrypoint for the pipelined-SUMMA A/B
+        _i = sys.argv.index("--vc2d-pipeline-lane")
+        print(json.dumps(vc2d_pipeline_lane(int(sys.argv[_i + 1]))))
     else:
         main()

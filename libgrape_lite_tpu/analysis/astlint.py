@@ -1211,13 +1211,51 @@ def _check_r10(module: _Scope, path: str,
             ))
 
 
+#: the sanctioned spellings of the SUMMA mesh axis names — the string
+#: values of parallel/comm_spec.VC_ROW_AXIS / VC_COL_AXIS.  Inlined
+#: (not imported) on purpose: the lint must keep flagging the raw
+#: strings even if the runtime constants are renamed out from under
+#: the literal copies it hunts.
+_R11_AXIS_LITERALS = ("vcrow", "vccol")
+
+
+def _check_r11(module: _Scope, path: str,
+               findings: List[Finding]) -> None:
+    """R11 raw-axis-name.  A models/ module that spells a SUMMA mesh
+    axis name as a raw string literal ('vcrow'/'vccol') holds a
+    private copy of the mesh contract: every pmin/psum/ppermute over
+    the 2-D mesh is only correct because its axis name matches
+    mesh2d()'s, and a renamed or extended mesh would miss the literal
+    silently — wrong-axis collective, not an import error.  Importing
+    VC_ROW_AXIS/VC_COL_AXIS from parallel/comm_spec.py is the
+    sanctioned form (the defining module itself, and non-model layers
+    like the worker/bench that never open a collective over the axis,
+    are out of scope)."""
+    if "/models/" not in "/" + path:
+        return
+    for n in ast.walk(module.node):
+        if (
+            isinstance(n, ast.Constant)
+            and isinstance(n.value, str)
+            and n.value in _R11_AXIS_LITERALS
+        ):
+            findings.append(Finding(
+                "R11", path, n.lineno, "<module>",
+                f"raw SUMMA axis name {n.value!r} in models/ — a "
+                "private copy of the mesh contract; import "
+                "VC_ROW_AXIS/VC_COL_AXIS from parallel/comm_spec.py "
+                "so a mesh rename is a compile-time error instead of "
+                "a wrong-axis collective",
+            ))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
 
 def lint_source(src: str, relpath: str) -> List[Finding]:
-    """All R1-R10 findings for one module's source text."""
+    """All R1-R11 findings for one module's source text."""
     relpath = relpath.replace(os.sep, "/")
     try:
         tree = ast.parse(src)
@@ -1244,6 +1282,7 @@ def lint_source(src: str, relpath: str) -> List[Finding]:
     _check_r8(module, relpath, findings)
     _check_r9(module, relpath, findings)
     _check_r10(module, relpath, findings)
+    _check_r11(module, relpath, findings)
     return findings
 
 

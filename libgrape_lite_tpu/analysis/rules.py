@@ -159,6 +159,20 @@ RULES: Dict[str, Rule] = {
             "baseline over the migrated tree)",
         ),
         Rule(
+            "R11", "raw-axis-name",
+            "a models/ module spells a SUMMA mesh axis name as a raw "
+            "string literal ('vcrow'/'vccol') instead of importing "
+            "VC_ROW_AXIS/VC_COL_AXIS from parallel/comm_spec.py — a "
+            "private copy of the mesh contract that a rename (or a "
+            "third axis) silently misses, turning a compile-time "
+            "import error into a wrong-axis collective at runtime",
+            "PR 19 (preventive): the pipelined SUMMA round put the "
+            "row-axis psum on the hot path of three apps at once; "
+            "every collective's correctness now hangs on the axis "
+            "names matching mesh2d()'s, so the string form is "
+            "fossilized out of models/ (zero-entry baseline)",
+        ),
+        Rule(
             "A1", "constant-bloat",
             "the lowered HLO of a fused runner holds a literal "
             "constant above the byte threshold — an R1 escape "

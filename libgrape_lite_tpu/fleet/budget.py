@@ -118,7 +118,24 @@ def fragment_bytes(frag) -> int:
     twins (identical shapes/dtypes to the stacked device arrays), so
     the price is the same whether the fragment is currently resident
     or evicted.  Undirected fragments alias ie onto oe and pay once,
-    like the device build."""
+    like the device build.
+
+    A vertex-cut (2-D SUMMA) fragment is priced from its host tile
+    buffers instead: its `host_ie`/`host_oe` are DERIVED per-tile COO
+    views that never ship to the device, so pricing them would charge
+    the fleet for bytes that are never placed."""
+    tiles = getattr(frag, "_host_tiles", None)
+    if tiles is not None:
+        s_arr, d_arr, w_arr, m_arr = tiles
+        total = s_arr.nbytes + d_arr.nbytes + m_arr.nbytes
+        if w_arr is not None:
+            total += w_arr.nbytes
+        # per-device vertex planes: carry mask [k*vc] (bool) on the
+        # row axis + oid plane (i64) + ivnum scalar per tile
+        k, vc = frag.k, frag.vc
+        total += k * k * (k * vc) * 1 + frag.fnum * (8 * frag.vp + 4)
+        return int(total)
+
     def csr(csrs):
         b = 0
         for c in csrs:

@@ -22,14 +22,32 @@ from dataclasses import dataclass
 from functools import partial
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+from libgrape_lite_tpu.obs.federation import FederatedStats
+from libgrape_lite_tpu.parallel.comm_spec import CommSpec, put_global
 
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
+
+
+# 2-D tile fill / pad-waste observability (OpenMetrics via the obs
+# federation, namespace "vc_tiles"): the vertex-cut analogue of the
+# rebalancer's before/after edge-skew record — every tile_stats() scan
+# publishes the latest fill profile so 2-D skew is scrapeable
+VC_TILE_STATS = FederatedStats("vc_tiles", {
+    "scans": 0,
+    "tiles": 0,
+    "edge_slots": 0,        # padded COO slots per tile (Ep)
+    "edges": 0,             # real edges across all tiles
+    "pad_slots": 0,         # fnum*Ep - edges: allocated-but-dead slots
+    "pad_waste_frac": 0.0,  # pad_slots / (fnum*Ep)
+    "min_fill_frac": 0.0,
+    "mean_fill_frac": 0.0,
+    "max_fill_frac": 0.0,
+    "tile_skew": 0.0,
+})
 
 
 @partial(
@@ -165,24 +183,54 @@ class ImmutableVertexcutFragment:
     def tile_stats(self) -> dict:
         """Per-tile real edge counts + the skew summary the planner,
         the bench `partition2d` lane and trace_report all read —
-        the 2-D analogue of edgecut's partition-skew warning."""
+        the 2-D analogue of edgecut's partition-skew warning.  HOST
+        data only (`_host_tiles`): under jax.distributed the device
+        tiles span non-addressable devices and cannot be fetched (the
+        PR 18 edgecut.inner_vertices_num bug class).  Also publishes
+        the fill / pad-waste profile into the "vc_tiles" federation
+        namespace so 2-D skew is scrapeable like the rebalancer's
+        edge-skew record."""
         _, _, _, m_arr = self._host_tiles
+        ep = int(m_arr.shape[1])
         counts = m_arr.sum(axis=1).astype(int)
         mean = max(float(counts.mean()), 1.0)
+        fills = counts / max(ep, 1)
+        edges = int(counts.sum())
+        pad = self.fnum * ep - edges
+        skew = round(float(counts.max()) / mean, 3)
+        VC_TILE_STATS["scans"] += 1
+        VC_TILE_STATS.update({
+            "tiles": self.fnum,
+            "edge_slots": ep,
+            "edges": edges,
+            "pad_slots": pad,
+            "pad_waste_frac": round(pad / max(self.fnum * ep, 1), 4),
+            "min_fill_frac": round(float(fills.min()), 4),
+            "mean_fill_frac": round(float(fills.mean()), 4),
+            "max_fill_frac": round(float(fills.max()), 4),
+            "tile_skew": skew,
+        })
         return {
             "k": self.k,
             "per_tile": [
                 {"tile": f, "row": f // self.k, "col": f % self.k,
-                 "edges": int(c)}
-                for f, c in enumerate(counts)
+                 "edges": int(c), "fill_frac": round(float(fr), 4)}
+                for f, (c, fr) in enumerate(zip(counts, fills))
             ],
             "max_tile_edges": int(counts.max()),
             "mean_tile_edges": round(mean, 1),
-            "tile_skew": round(float(counts.max()) / mean, 3),
+            "tile_skew": skew,
+            "edge_slots": ep,
+            "pad_slots": pad,
+            "pad_waste_frac": round(pad / max(self.fnum * ep, 1), 4),
         }
 
     # masters: the diagonal fragment (c, c) owns chunk c
-    # (reference partitioner.h:269-330 master placement)
+    # (reference partitioner.h:269-330 master placement).  Both reads
+    # are HOST-side (`_chunk_oids` from the build-time oid array) by
+    # audit: the device tiles span non-addressable devices under
+    # jax.distributed and must never back these (the bug class PR 18
+    # fixed in edgecut.inner_vertices_num).
     def inner_vertices_num(self, fid: int) -> int:
         i, j = divmod(fid, self.k)
         return len(self._chunk_oids[i]) if i == j else 0
@@ -190,6 +238,60 @@ class ImmutableVertexcutFragment:
     def inner_oids(self, fid: int) -> np.ndarray:
         i, j = divmod(fid, self.k)
         return self._chunk_oids[i] if i == j else np.zeros(0, np.int64)
+
+    # ---- device residency (fleet/ eviction, docs/FLEET.md) ----
+
+    def _place_tiles(self) -> "VCDeviceFragment":
+        """Deterministic device placement of the host tile blocks —
+        shared by build and restore_device, so a restored fragment's
+        content is byte-identical to the evicted one.  put_global (not
+        bare device_put): under jax.distributed the frag sharding
+        spans non-addressable devices and device_put would throw (the
+        same multi-process contract every 1-D placement site honors)."""
+        s_arr, d_arr, w_arr, m_arr = self._host_tiles
+        shard = self.comm_spec.sharded()
+
+        def put(x):
+            return put_global(x, shard)
+
+        return VCDeviceFragment(
+            src=put(s_arr), dst=put(d_arr), w=put(w_arr),
+            mask=put(m_arr),
+            fnum=self.fnum, k=self.k, vc=self.vc, chunk=self.chunk,
+            total_vnum=self.total_vnum,
+        )
+
+    def release_device(self) -> bool:
+        """Evict: delete the stacked COO tile buffers and drop `dev`.
+        Every host artifact survives — `_host_tiles`, the cached
+        per-tile CSR views, the pack-plan cache weak-keyed on THIS
+        object — so `restore_device` re-places byte-identical content
+        with zero pack re-planning (the 1-D fleet contract).  Returns
+        False when already released."""
+        if self.dev is None:
+            return False
+        seen = set()
+        for leaf in jax.tree_util.tree_leaves(self.dev):
+            if leaf is None or id(leaf) in seen:
+                continue
+            seen.add(id(leaf))
+            delete = getattr(leaf, "delete", None)
+            if callable(delete):
+                try:
+                    delete()
+                except Exception:
+                    pass  # committed/donated buffers: GC frees them
+        self.dev = None
+        return True
+
+    def restore_device(self) -> bool:
+        """Re-admission: re-place the device tiles from `_host_tiles`
+        (deterministic, byte-identical to the evicted arrays).
+        Returns False when already resident."""
+        if self.dev is not None:
+            return False
+        self.dev = self._place_tiles()
+        return True
 
     @classmethod
     def build(
@@ -256,21 +358,13 @@ class ImmutableVertexcutFragment:
                 w_arr[f, :n] = np.asarray(weights)[sel]
             m_arr[f, :n] = True
 
-        shard = comm_spec.sharded()
-
-        def put(x):
-            return None if x is None else jax.device_put(jnp.asarray(x), shard)
-
-        dev = VCDeviceFragment(
-            src=put(s_arr), dst=put(d_arr), w=put(w_arr), mask=put(m_arr),
-            fnum=fnum, k=k, vc=vc, chunk=chunk, total_vnum=len(oids),
-        )
-        out = cls(comm_spec, dev, oids, k, vc, chunk, real_enum,
+        out = cls(comm_spec, None, oids, k, vc, chunk, real_enum,
                   directed=directed, weighted=weights is not None,
                   symmetrized=symmetrize)
         # host tile blocks stay resident: the per-tile CSR views
-        # (host_ie/host_oe), tile_stats and the ft content fingerprint
-        # all read them — the edge-cut fragment keeps its host CSRs the
-        # same way
+        # (host_ie/host_oe), tile_stats, the ft content fingerprint and
+        # fleet re-admission (restore_device) all read them — the
+        # edge-cut fragment keeps its host CSRs the same way
         out._host_tiles = (s_arr, d_arr, w_arr, m_arr)
+        out.dev = out._place_tiles()
         return out

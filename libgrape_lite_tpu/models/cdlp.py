@@ -34,6 +34,11 @@ class CDLP(ParallelAppBase):
     message_strategy = MessageStrategy.kAlongOutgoingEdgeToOuterVertex
     result_format = "int"
     replicated_keys = frozenset({"step", "lut"})
+    # r9: the mode fold is per-row multiset arithmetic — splitting the
+    # edge set by destination row (boundary/interior) and folding each
+    # part separately reproduces every row's (src,label) run structure
+    # exactly, so the double-buffered round is byte-identical
+    pipeline_state_key = "labels"
 
     def __init__(self, max_round: int = 10, label_dtype=np.int64):
         self.max_round = max_round
@@ -69,19 +74,36 @@ class CDLP(ParallelAppBase):
         # static sorted label universe (labels only ever move between
         # existing ids); +1 slot so searchsorted results stay in range
         lut = np.sort(np.append(labels.reshape(-1), big))
-        return {"labels": labels, "step": np.int32(0), "lut": lut}
+        state = {"labels": labels, "step": np.int32(0), "lut": lut}
+        # superstep pipelining (r9): gather exchange, oe pull; CDLPOpt
+        # inherits (its shortcut only replaces peval — round 1 runs
+        # serial on either path)
+        from libgrape_lite_tpu.parallel.pipeline import resolve_pipeline
 
-    def _propagate(self, ctx, frag, labels, lut):
-        oe = frag.oe
-        vp = frag.vp
-        dt = labels.dtype
+        self._pipeline = resolve_pipeline(
+            frag, app_name=type(self).__name__, key="labels",
+            direction="oe", mirror=None, pack=None, fold="min",
+            with_weights=False,
+        )
+        if self._pipeline is not None:
+            state.update(self._pipeline.host_entries)
+            self.ephemeral_keys = frozenset(self._pipeline.host_entries)
+        self._pipeline_uid = (
+            self._pipeline.uid if self._pipeline is not None else -1
+        )
+        return state
+
+    def _mode_fold(self, src, lab, full, lut, vp):
+        """Per-row mode label from one (src, label) edge multiset:
+        sort, run-length encode, max-run per row, ties to smallest
+        label — the TPU counting kernel shared by the serial round and
+        both pipelined parts (the fold only ever groups edges of equal
+        src, so any edge subset CLOSED over destination rows — the
+        full set, the boundary part, the interior part — yields the
+        per-row result of the full fold for the rows it covers)."""
+        dt = lab.dtype
         big = jnp.asarray(np.iinfo(np.dtype(dt).name).max, dt)
-
-        full = ctx.gather_state(labels)
-        lab = jnp.where(oe.edge_mask, full[oe.edge_nbr], big)
-        src = jnp.where(oe.edge_mask, oe.edge_src, jnp.int32(vp))
-
-        n_pad = vp * frag.fnum
+        n_pad = full.shape[0]
         rank_bits = max(1, int(np.ceil(np.log2(n_pad + 2))))
         src_bits = max(1, int(np.ceil(np.log2(vp + 2))))
         from jax import lax as jlax
@@ -185,11 +207,69 @@ class CDLP(ParallelAppBase):
         cmax = self.segment_reduce(c_e, ss, vp, "max")
         is_best = jnp.logical_and(valid, c_e == cmax[jnp.minimum(ss, vp - 1)])
         cand = jnp.where(is_best, ll, big)
-        new_lab = self.segment_reduce(cand, ss, vp, "min")
+        return self.segment_reduce(cand, ss, vp, "min")
+
+    def _propagate(self, ctx, frag, labels, lut):
+        oe = frag.oe
+        vp = frag.vp
+        dt = labels.dtype
+        big = jnp.asarray(np.iinfo(np.dtype(dt).name).max, dt)
+
+        full = ctx.gather_state(labels)
+        lab = jnp.where(oe.edge_mask, full[oe.edge_nbr], big)
+        src = jnp.where(oe.edge_mask, oe.edge_src, jnp.int32(vp))
+        new_lab = self._mode_fold(src, lab, full, lut, vp)
 
         has_out = frag.out_degree > 0
         keep = jnp.logical_or(~frag.inner_mask, ~has_out)
         return jnp.where(jnp.logical_or(keep, new_lab == big), labels, new_lab)
+
+    def inceval_pipelined(self, ctx: StepContext, frag, state, xbuf):
+        """Double-buffered round (parallel/pipeline.py, r9): fold the
+        mode over the BOUNDARY rows' edges, kick off the next round's
+        label exchange from them, fold the interior rows' edges under
+        the in-flight collective, join.  Byte-identical to inceval:
+        the edge split is closed over destination rows, so each part's
+        (src,label) run structure matches the full fold row-for-row
+        (see _mode_fold)."""
+        pl = self._pipeline
+        labels = state["labels"]
+        lut = state["lut"]
+        vp = frag.vp
+        dt = labels.dtype
+        big = jnp.asarray(np.iinfo(np.dtype(dt).name).max, dt)
+        step = state["step"] + 1
+        bmask = state["pl_bmask"]
+        has_out = frag.out_degree > 0
+        keep = jnp.logical_or(~frag.inner_mask, ~has_out)
+        full = pl.splice(ctx, labels, state, xbuf)
+        lab_b = jnp.where(
+            state["pl_b_val"], full[state["pl_b_nbr"]], big
+        )
+        fold_b = self._mode_fold(
+            state["pl_b_src"], lab_b, full, lut, vp
+        )
+        new_b = jnp.where(
+            jnp.logical_or(keep, fold_b == big), labels, fold_b
+        )
+        xbuf2 = pl.kickoff(ctx, jnp.where(bmask, new_b, labels), state)
+        # ---- pipelined window: carry reads below are named in
+        # parallel/pipeline.PIPELINE_WINDOW_READS (grape-lint R6) ----
+        lab_i = jnp.where(
+            state["pl_i_val"], full[state["pl_i_nbr"]], big
+        )
+        fold_i = self._mode_fold(
+            state["pl_i_src"], lab_i, full, lut, vp
+        )
+        new_i = jnp.where(
+            jnp.logical_or(keep, fold_i == big), labels, fold_i
+        )
+        new = jnp.where(bmask, new_b, new_i)
+        active = jnp.where(
+            step >= jnp.int32(self.max_round), jnp.int32(0),
+            jnp.int32(1),
+        )
+        return {"labels": new, "step": step, "lut": lut}, active, xbuf2
 
     def peval(self, ctx: StepContext, frag, state):
         # reference PEval: step=1, one propagation (cdlp.h PEval)

@@ -68,10 +68,59 @@ def vc_transpose(x, k):
     return lax.ppermute(x, (VC_ROW_AXIS, VC_COL_AXIS), perm)
 
 
+def _phase_view(frag, lo, hi):
+    """A STATIC slice of the traced tile's COO edge ring — the
+    phase-0/phase-1 halves of the pipelined SUMMA round.  Pure python
+    slicing of the per-shard [Ep] leaves (lo/hi are host ints from the
+    resolved plan), so both phases fold the identical segment machinery
+    over disjoint slot ranges of the same arrays; pad slots carry
+    mask=False and fold to the identity either side of the cut."""
+    import dataclasses
+
+    return dataclasses.replace(
+        frag,
+        src=frag.src[lo:hi],
+        dst=frag.dst[lo:hi],
+        w=None if frag.w is None else frag.w[lo:hi],
+        mask=frag.mask[lo:hi],
+    )
+
+
+def vc_source_carry(frag, source, app_name: str, fill, hit, dtype):
+    """`[k*vc]` gpid-space carry seeded at `source` — or `[B, k*vc]`
+    when `source` is a sequence (the batched init contract of
+    `batch_query_key`, the vc2d analogue of app.base's
+    source_lane_array).  Out-of-range sources leave their lane all
+    `fill` (every vertex unreachable), logged like the 1-D apps."""
+    batched = isinstance(source, (list, tuple, np.ndarray))
+    srcs = np.asarray(
+        source if batched else [source], dtype=np.int64
+    ).reshape(-1)
+    arr = np.full((len(srcs), frag.k * frag.vc), fill, dtype=dtype)
+    for b, s in enumerate(srcs):
+        if 0 <= s < frag.k * frag.chunk:
+            arr[b, int(frag.oid_to_gpid(np.array([s]))[0])] = hit
+        else:
+            from libgrape_lite_tpu.utils import logging as glog
+
+            glog.log_info(
+                f"{app_name}: source {int(s)!r} is outside the oid "
+                "space; all vertices will be unreachable"
+            )
+    return arr if batched else arr[0]
+
+
 def vc_finalize_rows(frag, flat: np.ndarray) -> np.ndarray:
     """Compact a gpid-space [k*vc] result into [fnum, vc] rows aligned
     with inner_oids order (masters = diagonal fragments) — the Worker
-    output contract shared by every vertex-cut app."""
+    output contract shared by every vertex-cut app.  A carry leaf that
+    spans non-addressable devices (jax.distributed) is gathered via
+    process_allgather first — np.asarray on it would throw (the PR 18
+    edgecut bug class; same idiom as worker.result_values)."""
+    if not getattr(flat, "is_fully_addressable", True):
+        from jax.experimental import multihost_utils
+
+        flat = np.asarray(multihost_utils.process_allgather(flat))
     vals = np.asarray(flat).reshape(frag.k, frag.vc)
     out = np.zeros((frag.fnum, frag.vc), dtype=vals.dtype)
     for c in range(frag.k):
@@ -121,6 +170,18 @@ class VC2DMinAppBase(GatherScatterAppBase):
             self._resolve_tile_packs(frag, eph_entries)
         self._pack_uid = (
             self._pack_ie.uid if self._pack_ie is not None else -1
+        )
+        from libgrape_lite_tpu.parallel.pipeline import (
+            resolve_vc2d_pipeline,
+        )
+
+        self._pipeline = resolve_vc2d_pipeline(
+            frag, app_name=type(self).__name__, pack=self._pack_ie,
+            src_pull=self._src_pull,
+            dtype_bytes=int(np.dtype(carry.dtype).itemsize),
+        )
+        self._pipeline_uid = (
+            self._pipeline.uid if self._pipeline is not None else "-"
         )
         state.update(eph_entries)
         self.ephemeral_keys = frozenset(eph_entries)
@@ -203,6 +264,41 @@ class VC2DMinAppBase(GatherScatterAppBase):
         active = lax.psum(changed.sum().astype(jnp.int32), VC_ROW_AXIS)
         return {self.state_key: new}, active
 
+    # ---- the pipelined SUMMA round (VC2DPipelinePlan) ----
+
+    def pipeline_exchange(self, ctx: StepContext, frag, state):
+        """The SUMMA round has no cross-round halo table: the carry's
+        row replication along the column axis IS the broadcast, and the
+        row-axis pmin completes inside the round.  The worker's
+        pipelined loop still carries an exchange buffer, so hand it an
+        inert scalar — re-derived at every chunk entry to the same
+        constant, keeping the observable cut contract vacuously."""
+        return jnp.int32(0)
+
+    def inceval_pipelined(self, ctx: StepContext, frag, state, xbuf):
+        """The two-phase round: fold phase 0, kick its row-axis pmin,
+        fold phase 1 UNDER the in-flight collective, complete with the
+        second pmin and merge.  min(pmin(fold0), pmin(fold1)) is
+        bitwise pmin(fold(all slots)) — min regrouping over disjoint
+        static slices of the same edge arrays is exact (ints and IEEE
+        floats; no float addition crosses the cut), so the result is
+        byte-identical to `inceval` (the directed src-pull form never
+        resolves a plan, see resolve_vc2d_pipeline)."""
+        k = frag.k
+        pl = self._pipeline
+        val = state[self.state_key]  # [vc] chunk i (row copy)
+        f0 = _phase_view(frag, 0, pl.split)
+        f1 = _phase_view(frag, pl.split, None)
+        p0 = self._dst_partial(ctx, f0, val, state)
+        r0 = lax.pmin(p0, VC_ROW_AXIS)  # kicked; phase 1 overlaps it
+        p1 = self._dst_partial(ctx, f1, val, state)
+        r1 = lax.pmin(p1, VC_ROW_AXIS)
+        relax_row = vc_transpose(jnp.minimum(r0, r1), k)
+        new = jnp.minimum(val, relax_row)
+        changed = jnp.logical_and(new < val, state["vmask_row"])
+        active = lax.psum(changed.sum().astype(jnp.int32), VC_ROW_AXIS)
+        return {self.state_key: new}, active, xbuf
+
     def finalize(self, frag, state):
         return vc_finalize_rows(frag, np.asarray(state[self.state_key]))
 
@@ -216,6 +312,7 @@ class SSSPVC2D(VC2DMinAppBase):
     result_format = "sssp_infinity"
     needs_edata = True
     needs_weights = True
+    batch_query_key = "source"
 
     def _pack_eligible(self, frag):
         import jax
@@ -239,17 +336,9 @@ class SSSPVC2D(VC2DMinAppBase):
         dtype = w_arr.dtype
         if not jax.config.jax_enable_x64:
             dtype = np.float32
-        dist = np.full(frag.k * frag.vc, np.inf, dtype=dtype)
-        src = int(source)
-        if 0 <= src < frag.k * frag.chunk:
-            dist[int(frag.oid_to_gpid(np.array([src]))[0])] = 0.0
-        else:
-            from libgrape_lite_tpu.utils import logging as glog
-
-            glog.log_info(
-                f"SSSPVC2D: source {source!r} is outside the oid "
-                "space; all vertices will be unreachable"
-            )
+        dist = vc_source_carry(
+            frag, source, "SSSPVC2D", np.inf, 0.0, dtype
+        )
         return self._init_common(frag, dist)
 
     def _dst_partial(self, ctx, frag, val_row, state):
@@ -277,19 +366,12 @@ class BFSVC2D(VC2DMinAppBase):
 
     state_key = "depth"
     result_format = "int"
+    batch_query_key = "source"
 
     def init_state(self, frag, source=0):
-        depth = np.full(frag.k * frag.vc, _INT_SENT, dtype=np.int32)
-        src = int(source)
-        if 0 <= src < frag.k * frag.chunk:
-            depth[int(frag.oid_to_gpid(np.array([src]))[0])] = 0
-        else:
-            from libgrape_lite_tpu.utils import logging as glog
-
-            glog.log_info(
-                f"BFSVC2D: source {source!r} is outside the oid "
-                "space; all vertices will be unreachable"
-            )
+        depth = vc_source_carry(
+            frag, source, "BFSVC2D", _INT_SENT, 0, np.int32
+        )
         return self._init_common(frag, depth)
 
     def _dst_partial(self, ctx, frag, val_row, state):

@@ -31,10 +31,13 @@ class WCC(ParallelAppBase):
     dyn_overlay_support = True
     inc_mode = "monotone-min"
     inc_seed_keys = {"comp": "min"}
-    # r9: min-gid propagation pipelines on UNDIRECTED graphs (one pull
-    # per round); the directed form's oe pull reads the ie-folded
-    # labels mid-round — a second, dependent exchange that the
-    # double-buffered body cannot hide, so it stays serial
+    # r9: min-gid propagation pipelines in BOTH graph forms.  The
+    # undirected round is the canonical single-pull split; the
+    # directed round runs the two-kickoff double-pull form — the oe
+    # exchange is kicked from the ie BOUNDARY fold (complete at every
+    # remotely-read row under the joint ie+oe boundary mask) and
+    # rides under the ie INTERIOR fold, then the next round's ie
+    # exchange kicks from the oe boundary fold symmetrically
     pipeline_state_key = "comp"
 
     def init_state(self, frag, **_):
@@ -103,7 +106,8 @@ class WCC(ParallelAppBase):
                     eph_entries.update(ie.state_entries())
                     if oe is not None:
                         eph_entries.update(oe.state_entries())
-        # superstep pipelining (r9): single-pull (undirected) form only
+        # superstep pipelining (r9): undirected single-pull split, or
+        # the directed two-kickoff double-pull form (leg 2 = oe)
         self._pipeline = None
         if not self._dyn:
             from libgrape_lite_tpu.parallel.pipeline import resolve_pipeline
@@ -112,14 +116,12 @@ class WCC(ParallelAppBase):
                 frag, app_name="WCC", key="comp", direction="ie",
                 mirror=self._mx_ie, mx_prefix="mx_ie_",
                 pack=self._pack_ie, fold="min", with_weights=False,
-                eligible=(
-                    not frag.directed
-                    and type(self)._post_pull is WCC._post_pull
-                ),
-                reason="directed WCC pulls oe against the ie-folded "
-                       "labels (dependent second exchange per round), "
-                       "and _post_pull overrides (WCCOpt pointer "
-                       "jumping) are unaudited for the split",
+                direction2="oe" if frag.directed else None,
+                mirror2=self._mx_oe if frag.directed else None,
+                eligible=(type(self)._post_pull is WCC._post_pull),
+                reason="_post_pull overrides (WCCOpt pointer jumping) "
+                       "gather the folded labels again — a dependent "
+                       "third exchange the split cannot hide",
             )
             if self._pipeline is not None:
                 eph_entries.update(self._pipeline.host_entries)
@@ -195,8 +197,12 @@ class WCC(ParallelAppBase):
         """Double-buffered round (parallel/pipeline.py; see SSSP) for
         the undirected single-pull form: boundary label fold, exchange
         kickoff, interior fold under the in-flight collective, join —
-        bit-identical (min-gid is any-order exact)."""
+        bit-identical (min-gid is any-order exact).  Directed graphs
+        run the two-kickoff double-pull form instead."""
         pl = self._pipeline
+        if pl.mode2 is not None:
+            return self._inceval_pipelined_directed(ctx, frag, state,
+                                                    xbuf)
         comp = state["comp"]
         big = jnp.int32(np.iinfo(np.int32).max)
         full = pl.splice(ctx, comp, state, xbuf)
@@ -232,6 +238,65 @@ class WCC(ParallelAppBase):
             )
         new_i = jnp.minimum(comp, rel_i)
         new = jnp.where(bmask, new_b, new_i)
+        changed = jnp.logical_and(new < comp, frag.inner_mask)
+        active = ctx.sum(changed.sum().astype(jnp.int32))
+        return {"comp": new}, active, xbuf2
+
+    def _inceval_pipelined_directed(self, ctx: StepContext, frag,
+                                    state, xbuf):
+        """Two-kickoff double-pull round for directed graphs.  The
+        serial round's oe pull reads the ie-folded labels — a
+        dependent second exchange.  It pipelines anyway because the
+        joint ie+oe boundary mask makes the ie BOUNDARY fold complete
+        at every remotely-read row: the oe exchange kicks right after
+        it and hides under the ie INTERIOR fold; symmetrically, the
+        NEXT round's ie exchange kicks from the oe boundary fold and
+        hides under the oe interior fold.  Joins are min over disjoint
+        row sets — bit-identical to the serial two-pull round."""
+        pl = self._pipeline
+        comp = state["comp"]
+        big = jnp.int32(np.iinfo(np.int32).max)
+        bmask = state["pl_bmask"]
+        # leg 1 (ie): last round kicked this exchange; splice + fold
+        # the boundary rows' edges first
+        full1 = pl.splice(ctx, comp, state, xbuf)
+        cand = jnp.where(
+            state["pl_b_val"], full1[state["pl_b_nbr"]], big
+        )
+        rel1_b = self.segment_reduce(
+            cand, state["pl_b_src"], frag.vp, "min"
+        )
+        new1_b = jnp.minimum(comp, rel1_b)
+        x_oe = pl.kickoff(
+            ctx, jnp.where(bmask, new1_b, comp), state, leg=2
+        )
+        # ---- pipelined window: carry reads below are named in
+        # parallel/pipeline.PIPELINE_WINDOW_READS (grape-lint R6) ----
+        cand = jnp.where(
+            state["pl_i_val"], full1[state["pl_i_nbr"]], big
+        )
+        rel1_i = self.segment_reduce(
+            cand, state["pl_i_src"], frag.vp, "min"
+        )
+        new1 = jnp.where(bmask, new1_b, jnp.minimum(comp, rel1_i))
+        # leg 2 (oe): remote rows of full2 come from x_oe, current at
+        # every remotely-read row (all boundary); local rows are live
+        full2 = pl.splice(ctx, new1, state, x_oe, leg=2)
+        cand = jnp.where(
+            state["pl2_b_val"], full2[state["pl2_b_nbr"]], big
+        )
+        rel2_b = self.segment_reduce(
+            cand, state["pl2_b_src"], frag.vp, "min"
+        )
+        new2_b = jnp.minimum(new1, rel2_b)
+        xbuf2 = pl.kickoff(ctx, jnp.where(bmask, new2_b, new1), state)
+        cand = jnp.where(
+            state["pl2_i_val"], full2[state["pl2_i_nbr"]], big
+        )
+        rel2_i = self.segment_reduce(
+            cand, state["pl2_i_src"], frag.vp, "min"
+        )
+        new = jnp.where(bmask, new2_b, jnp.minimum(new1, rel2_i))
         changed = jnp.logical_and(new < comp, frag.inner_mask)
         active = ctx.sum(changed.sum().astype(jnp.int32))
         return {"comp": new}, active, xbuf2

@@ -52,6 +52,7 @@ EXPECTED: Dict[str, str] = {
     "slo": "libgrape_lite_tpu.obs.slo",
     "recorder": "libgrape_lite_tpu.obs.recorder",
     "autopilot": "libgrape_lite_tpu.autopilot.signals",
+    "vc_tiles": "libgrape_lite_tpu.fragment.vertexcut",
 }
 
 

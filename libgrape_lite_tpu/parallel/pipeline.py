@@ -77,10 +77,17 @@ _MIN_BYTES_DEFAULT = 1 << 20
 PIPELINE_WINDOW_READS = frozenset({
     # live carry leaves the interior slice folds against
     "dist", "depth", "comp", "rank",
+    # CDLP's carry label plane (the join selector of the mode fold)
+    # and its replicated rank LUT (read by both part folds)
+    "labels", "lut",
     # PageRank's replicated scalars (read by the joined round_update)
     "step", "seed", "dangling_sum", "total_dangling",
     # the boundary mask (the join selector) and the interior streams
     "pl_bmask", "pl_i_src", "pl_i_nbr", "pl_i_val", "pl_i_w",
+    # the second-direction streams of the directed double-pull round
+    # (WCC oe leg) — both parts fold inside the window, which opens at
+    # the FIRST kickoff of the round
+    "pl2_*",
     # interior pack sub-plan streams (read inside PackDispatch.reduce)
     "pki_*",
 })
@@ -93,7 +100,16 @@ PIPELINE_WINDOW_READS = frozenset({
 #                 leaves (pki_*/pkb_ prefixes) plus the table argument
 #   round_update  PageRank — reads the replicated scalar keys named in
 #                 PIPELINE_WINDOW_READS above, elementwise per row
-PIPELINE_WINDOW_CALLEES = frozenset({"reduce", "round_update"})
+#   kickoff       PipelinePlan.kickoff — reads only its send_key leaf
+#                 (the mirror send table, a static host stream), never
+#                 a live carry value; the directed double-pull round
+#                 issues a SECOND kickoff inside the first's window
+#   splice        PipelinePlan.splice — reads nothing from the carry
+#                 dict at all (mirror mode concatenates its explicit
+#                 args; gather mode reads only ctx.fid())
+PIPELINE_WINDOW_CALLEES = frozenset({
+    "reduce", "round_update", "kickoff", "splice",
+})
 
 # resolve-path registry: the last pipeline decision + split stats, so
 # plan_stats()/trace_report can surface boundary-set sizes without
@@ -216,6 +232,12 @@ class PipelinePlan:
     decision: dict = field(default_factory=dict)
     host_entries: dict = field(default_factory=dict)
     ops_per_edge: Optional[float] = None
+    # second exchange leg of the directed double-pull round (WCC oe):
+    # None when single-direction.  leg=2 on exchange/kickoff/splice
+    # routes through these instead — same wiring, second direction.
+    mode2: Optional[str] = None
+    m2: int = 0
+    send_key2: str = ""
 
     @property
     def uid(self) -> str:
@@ -231,12 +253,20 @@ class PipelinePlan:
         routing facts the struct cannot see."""
         return (
             f"{self.mode}:{self.fnum}:{self.vp}:{self.m}:"
-            f"{'pack' if self.pack_b is not None else 'xla'}"
+            f"{'pack' if self.pack_b is not None else 'xla'}:"
+            f"{self.mode2 or '-'}"
         )
+
+    def _leg(self, leg: int):
+        if leg == 2:
+            if self.mode2 is None:
+                raise ValueError("pipeline plan has no second leg")
+            return self.mode2, self.send_key2
+        return self.mode, self.send_key
 
     # ---- traced (inside shard_map) ----
 
-    def exchange(self, ctx, x_local, state):
+    def exchange(self, ctx, x_local, state, leg: int = 1):
         """The halo exchange of `x_local`'s read rows — bitwise the
         payload of the serial round's exchange when the boundary rows
         of `x_local` are current (pad/interior rows are never read
@@ -244,29 +274,32 @@ class PipelinePlan:
         the serial round uses (one copy of the exchange wiring); the
         mirror form drops the helper's leading live-local block — the
         buffer must hold only remote rows, `splice` re-attaches the
-        LIVE local block at read time."""
-        if self.mode == "mirror":
+        LIVE local block at read time.  `leg=2` is the second
+        direction of the directed double-pull round."""
+        mode, send_key = self._leg(leg)
+        if mode == "mirror":
             compact = ctx.exchange_mirrors(
-                x_local, state[self.send_key]
+                x_local, state[send_key]
             )
             return compact[self.vp:]
         return ctx.gather_state(x_local)
 
-    def kickoff(self, ctx, x_kick, state):
-        """Kick off round k+1's exchange from the boundary-merged
+    def kickoff(self, ctx, x_kick, state, leg: int = 1):
+        """Kick off the NEXT pull's exchange from the boundary-merged
         carry (new values at boundary rows, stale elsewhere — the
         stale rows are never read).  Distinct name on purpose: this
         call opens the pipelined window grape-lint R6 audits."""
-        return self.exchange(ctx, x_kick, state)
+        return self.exchange(ctx, x_kick, state, leg=leg)
 
-    def splice(self, ctx, x_local, state, xbuf):
+    def splice(self, ctx, x_local, state, xbuf, leg: int = 1):
         """The full pull table for this round: LIVE local rows overlaid
         on the buffered remote rows — local reads are bitwise the
         serial value, remote reads hit only (current) boundary rows."""
         import jax.numpy as jnp
         from jax import lax
 
-        if self.mode == "mirror":
+        mode, _ = self._leg(leg)
+        if mode == "mirror":
             return jnp.concatenate([x_local, xbuf])
         fid = ctx.fid()
         return lax.dynamic_update_slice(xbuf, x_local, (fid * self.vp,))
@@ -374,14 +407,24 @@ def resolve_pipeline(frag, *, app_name: str, key: str,
                      direction: str = "ie", mirror=None,
                      mx_prefix: str = "mx_", pack=None,
                      fold: str = "min", with_weights: bool = False,
-                     eligible: bool = True, reason: str = ""):
+                     eligible: bool = True, reason: str = "",
+                     direction2: str | None = None, mirror2=None,
+                     mx2_prefix: str = "mx_oe_"):
     """Resolve the superstep pipeline for one app's pull, or None.
 
     `mirror`/`pack` are the app's ALREADY-RESOLVED exchange and SpMV
     backends — the pipelined round must use the same exchange mode and
     the same fold machinery as the serial one, or byte-identity is
     off the table.  Decline reasons are recorded in
-    PIPELINE_STATS["last_decision"] (and vlogged), never silent."""
+    PIPELINE_STATS["last_decision"] (and vlogged), never silent.
+
+    `direction2` requests the directed DOUBLE-PULL round (WCC on a
+    directed graph: an ie pull then an oe pull per superstep).  The
+    boundary mask becomes the JOINT split over both directions — a row
+    any remote fragment reads through either edge orientation is
+    boundary — so each pull's kickoff payload is current at every
+    remotely-read row, and the second leg's streams ride under the
+    `pl2_` prefix with their own exchange mode (`mirror2`)."""
     from libgrape_lite_tpu.utils import logging as glog
 
     mode = pipeline_mode()
@@ -412,12 +455,27 @@ def resolve_pipeline(frag, *, app_name: str, key: str,
         # float-parity limit); byte-identity wins
         return declined("sum fold over the pack backend is not "
                         "bit-stable under a split plan")
+    if direction2 is not None and pack is not None:
+        # the double-pull round would need FOUR pack sub-plans (b/i per
+        # direction) whose split fold order is unaudited against the
+        # serial two-pull round; the XLA stream path is the pipelined
+        # form until that audit lands
+        return declined("directed double-pull over the pack backend "
+                        "is unaudited; XLA streams only")
 
     xmode = "mirror" if mirror is not None else "gather"
     bytes_ledger = exchange_bytes_ledger(
         frag.fnum, frag.vp, mirror.m if mirror is not None else None
     )
     xbytes = bytes_ledger[xmode] or 0
+    xmode2 = None
+    if direction2 is not None:
+        xmode2 = "mirror" if mirror2 is not None else "gather"
+        ledger2 = exchange_bytes_ledger(
+            frag.fnum, frag.vp,
+            mirror2.m if mirror2 is not None else None,
+        )
+        xbytes += ledger2[xmode2] or 0
     decision["exchange_bytes"] = xbytes
     decision["min_bytes"] = pipeline_min_bytes()
     if mode == "auto" and xbytes < pipeline_min_bytes():
@@ -431,8 +489,19 @@ def resolve_pipeline(frag, *, app_name: str, key: str,
         boundary_split, boundary_stats,
     )
 
-    bmask = boundary_split(frag, (direction,))
+    directions = (direction,) if direction2 is None \
+        else (direction, direction2)
+    bmask = boundary_split(frag, directions)
     stats = boundary_stats(frag, bmask, direction)
+    if direction2 is not None:
+        # both pulls fold inside the same round: edge totals sum, the
+        # vertex split is shared (one joint mask)
+        stats2 = boundary_stats(frag, bmask, direction2)
+        for part in ("boundary_edges", "interior_edges"):
+            stats["totals"][part] = (
+                stats["totals"].get(part, 0)
+                + stats2["totals"].get(part, 0)
+            )
 
     min_hidden = pipeline_min_hidden_us()
     if mode == "auto" and min_hidden > 0:
@@ -480,6 +549,12 @@ def resolve_pipeline(frag, *, app_name: str, key: str,
         host_entries.update(_split_streams(
             frag, bmask, direction, mirror, with_weights, "pl_"
         ))
+        if direction2 is not None:
+            h2 = _split_streams(
+                frag, bmask, direction2, mirror2, with_weights, "pl2_"
+            )
+            h2.pop("pl2_bmask")  # one joint mask, already under pl_
+            host_entries.update(h2)
 
     decision["engaged"] = True
     plan = PipelinePlan(
@@ -489,6 +564,9 @@ def resolve_pipeline(frag, *, app_name: str, key: str,
         pack_b=pack_b, pack_i=pack_i,
         stats=stats, exchange_bytes=xbytes, decision=decision,
         host_entries=host_entries, ops_per_edge=ops_per_edge,
+        mode2=xmode2,
+        m2=mirror2.m if mirror2 is not None else 0,
+        send_key2=mx2_prefix + "send",
     )
     PIPELINE_STATS["resolved"] += 1
     PIPELINE_STATS["last_decision"] = decision
@@ -499,5 +577,195 @@ def resolve_pipeline(frag, *, app_name: str, key: str,
         app_name, xmode, xbytes,
         stats["totals"].get("boundary_vertices", 0),
         stats["totals"].get("interior_vertices", 0),
+    )
+    return plan
+
+
+# ---- the 2-D vertex-cut (SUMMA) pipeline ----------------------------------
+
+
+@dataclass
+class VC2DPipelinePlan:
+    """The pipelined SUMMA round: a two-phase split of each tile's COO
+    edge ring so the row-axis `pmin` of the phase-0 partial overlaps
+    the phase-1 tile-local fold (docs/PARTITION2D.md "Overlapped
+    round").
+
+      serial:     partial = fold(ALL edge slots); pmin(row); transpose
+      pipelined:  p0 = fold(slots [:split]); r0 = pmin(p0)  <- kicked
+                  p1 = fold(slots [split:])                 <- overlaps
+                  r1 = pmin(p1); relax = min(r0, r1); transpose
+
+    Byte-identity argument: min is associative/commutative and
+    idempotent over any regrouping of the same candidate multiset, and
+    both folds run the identical segment reduction over disjoint
+    static slices of the SAME per-shard edge arrays — min(r0, r1)
+    is elementwise equal, bit for bit, to the serial pmin of the
+    unsplit fold (ints and IEEE floats alike; no float addition
+    regroups).  The phase split is static slicing of the device COO —
+    no extra host streams, so `host_entries` is empty and the
+    exchange buffer is an inert scalar (the SUMMA round has no
+    cross-round halo table to double-buffer).
+
+    The split doubles the COLLECTIVE COUNT (two [vc] pmins instead of
+    one) but only the first is hidden; `exchange_bytes` prices the
+    hideable leg and the auto gate sees exactly that."""
+
+    k: int
+    vc: int
+    split: int                  # phase-0 edge-slot count (per shard)
+    stats: dict = field(default_factory=dict)
+    exchange_bytes: int = 0
+    decision: dict = field(default_factory=dict)
+    host_entries: dict = field(default_factory=dict)
+    ops_per_edge: Optional[float] = None
+    mode: str = "vc2d"
+
+    @property
+    def uid(self) -> str:
+        """Stable trace fingerprint (rides `_pipeline_uid` in the
+        app's trace_key, same contract as PipelinePlan.uid)."""
+        return f"vc2d:{self.k}:{self.vc}:{self.split}"
+
+    def span_brief(self) -> dict:
+        t = self.stats.get("totals", {})
+        model = overlap_model(
+            t.get("boundary_edges", 0), t.get("interior_edges", 0),
+            self.exchange_bytes, self.ops_per_edge,
+        )
+        return {
+            "engaged": True,
+            "mode": self.mode,
+            "exchange_bytes": self.exchange_bytes,
+            "modeled_hidden_frac": model["hidden_frac"],
+            "hidden_us_per_round": self.hidden_us_per_round(),
+            "boundary_vertices": t.get("boundary_vertices", 0),
+            "interior_vertices": t.get("interior_vertices", 0),
+            "boundary_edges": t.get("boundary_edges", 0),
+            "interior_edges": t.get("interior_edges", 0),
+        }
+
+    def hidden_us_per_round(self) -> float:
+        t = self.stats.get("totals", {})
+        model = overlap_model(
+            t.get("boundary_edges", 0), t.get("interior_edges", 0),
+            self.exchange_bytes, self.ops_per_edge,
+        )
+        return round(
+            min(model["compute_interior_s"], model["exchange_s"]) * 1e6,
+            3,
+        )
+
+
+def resolve_vc2d_pipeline(frag, *, app_name: str, pack=None,
+                          src_pull: bool = False,
+                          dtype_bytes: int = 4):
+    """Resolve the pipelined SUMMA round for a vc2d app, or None.
+
+    Same engagement ladder as `resolve_pipeline` (GRAPE_PIPELINE
+    off/auto/force, the byte and hidden-µs auto floors, declines
+    recorded in PIPELINE_STATS — never silent), with the vc2d
+    structural gates:
+
+      * `src_pull` (directed WCC's column-axis pull) declines — the
+        second pull folds the TRANSPOSED relax of the first, a
+        dependent chain with no independent work to overlap;
+      * a resolved per-tile pack plan declines — it is one fused
+        dispatch whose phase split is unaudited;
+      * a tile ring too small to split in two 128-multiple phases
+        declines (nothing to overlap).
+
+    The decision record always carries the rate-profile label and the
+    modeled `hidden_us_per_round` (the bench `vc2d_pipeline` lane
+    gates on both being present)."""
+    from libgrape_lite_tpu.utils import logging as glog
+
+    mode = pipeline_mode()
+    prof = _active_profile()
+    decision = {"app": app_name, "mode": mode, "engaged": False,
+                "profile": prof.label(), "plan": "vc2d"}
+
+    def declined(why: str, count: bool = True):
+        decision["reason"] = why
+        PIPELINE_STATS["last_decision"] = decision
+        if count:
+            PIPELINE_STATS["declined"] += 1
+            glog.vlog(1, "pipeline: declined for %s: %s", app_name, why)
+        return None
+
+    if mode == "off":
+        return declined("GRAPE_PIPELINE off", count=False)
+    k = int(frag.k)
+    if k <= 1:
+        return declined("k==1: the row-axis pmin is a no-op")
+    if src_pull:
+        return declined(
+            "directed src-pull round: the column-axis pull consumes "
+            "the transposed row relax — a dependent chain with no "
+            "independent fold to overlap"
+        )
+    if pack is not None:
+        return declined(
+            "per-tile pack plan resolved: a single fused dispatch "
+            "whose phase split is unaudited; unset GRAPE_SPMV=pack "
+            "to pipeline the 2-D round"
+        )
+
+    _, _, _, m_arr = frag._host_tiles
+    ep = int(m_arr.shape[1])
+    split = min(_round_up(max(ep // 2, 1), 128), ep)
+    if split >= ep:
+        return declined(
+            f"tile edge ring too small to split ({ep} slots): "
+            "nothing to overlap"
+        )
+
+    # the hideable collective: ONE row-axis pmin of the [vc] partial
+    # per device — ring all-reduce over the k row peers
+    vc = int(frag.vc)
+    xbytes = int(vc * dtype_bytes * 2 * (k - 1) / k)
+    decision["exchange_bytes"] = xbytes
+    decision["min_bytes"] = pipeline_min_bytes()
+
+    # real (unpadded) edges per phase, summed over tiles — the phase-0
+    # fold is the "boundary" (pre-kick) term of the overlap model, the
+    # phase-1 fold the overlapped "interior" term
+    e0 = int(m_arr[:, :split].sum())
+    e1 = int(m_arr[:, split:].sum())
+    stats = {"totals": {
+        "boundary_edges": e0, "interior_edges": e1,
+        "boundary_vertices": 0, "interior_vertices": 0,
+        "phase_split": split, "edge_slots": ep,
+    }}
+    model = overlap_model(e0, e1, xbytes, profile=prof)
+    hidden_us = min(model["compute_interior_s"],
+                    model["exchange_s"]) * 1e6
+    decision["modeled_hidden_us"] = round(hidden_us, 3)
+
+    if mode == "auto" and xbytes < pipeline_min_bytes():
+        return declined(
+            f"modeled pmin bytes {xbytes} below threshold "
+            f"{pipeline_min_bytes()} (latency-bound; set "
+            "GRAPE_PIPELINE_MIN_BYTES or =force to override)"
+        )
+    min_hidden = pipeline_min_hidden_us()
+    if mode == "auto" and min_hidden > 0 and hidden_us < min_hidden:
+        return declined(
+            f"modeled hidden pmin {hidden_us:.2f}us under profile "
+            f"{prof.label()} is below the "
+            f"GRAPE_PIPELINE_MIN_HIDDEN_US={min_hidden:g} floor"
+        )
+
+    decision["engaged"] = True
+    plan = VC2DPipelinePlan(
+        k=k, vc=vc, split=split, stats=stats,
+        exchange_bytes=xbytes, decision=decision,
+    )
+    PIPELINE_STATS["resolved"] += 1
+    PIPELINE_STATS["last_decision"] = decision
+    PIPELINE_STATS["last_stats"] = stats
+    glog.vlog(
+        1, "pipeline: engaged vc2d for %s (k=%d, split %d/%d slots, "
+        "%d B pmin/round)", app_name, k, split, ep, xbytes,
     )
     return plan

@@ -58,12 +58,15 @@ class BatchPolicy:
 
 
 def compat_key(app_key: str, args: dict, max_rounds, guard,
-               batch_key: str | None):
+               batch_key: str | None, mesh_kind: str = "frag"):
     """Hashable coalescing key: requests with equal keys may share one
     batched dispatch.  `batch_key` (the app's per-lane query arg) is
     excluded — it is exactly what varies across lanes; everything else
     (app, round limit, guard policy, remaining args) must match or the
-    lanes would need different compiled runners."""
+    lanes would need different compiled runners.  `mesh_kind` is
+    structural too: a vc2d app compiles over the k x k SUMMA mesh and
+    must never coalesce (or share a result-cache identity) with a 1-D
+    frag-mesh dispatch of the same app key."""
     fixed = tuple(sorted(
         (k, v) for k, v in args.items() if k != batch_key
     ))
@@ -74,4 +77,5 @@ def compat_key(app_key: str, args: dict, max_rounds, guard,
     has_lane_arg = (
         batch_key is not None and args.get(batch_key) is not None
     )
-    return (app_key, max_rounds, str(policy), fixed, has_lane_arg)
+    return (app_key, max_rounds, str(policy), fixed, has_lane_arg,
+            mesh_kind)

@@ -78,6 +78,18 @@ class ServeSession:
             apps = dict(APP_REGISTRY)
         self.dyn = None
         if dyn is not None and dyn is not False:
+            # the delta overlay is an edge-cut side-path: the vc2d
+            # apps never read `dyn_overlay`, so a dyn vertex-cut
+            # session would serve STALE results silently — refuse
+            # loudly instead (docs/PARTITION2D.md "Serve + fleet")
+            if getattr(fragment, "_host_tiles", None) is not None:
+                raise ValueError(
+                    "dyn ingest is not supported on a vertex-cut "
+                    "fragment: the 2-D tile pulls do not read the "
+                    "delta overlay, so staged edges would be "
+                    "silently invisible; repack into a new fragment "
+                    "instead"
+                )
             from libgrape_lite_tpu.dyn import DynGraph, RepackPolicy
 
             if isinstance(dyn, DynGraph):
@@ -295,6 +307,7 @@ class ServeSession:
         return compat_key(
             app_key, args, max_rounds, guard or self.guard,
             getattr(self.apps[app_key], "batch_query_key", None),
+            getattr(self.apps[app_key], "mesh_kind", "frag"),
         ) + (tenant,)
 
     def _compat_key(self, req: QueryRequest) -> tuple:

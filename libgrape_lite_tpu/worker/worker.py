@@ -684,8 +684,9 @@ class Worker:
     # ---- batched multi-source execution (serve/) -------------------------
 
     def _check_batchable(self):
-        """Batched dispatch covers superstep apps on the 1-D frag mesh;
-        everything else fails loudly BEFORE a cryptic trace error."""
+        """Batched dispatch covers superstep apps on the 1-D frag mesh
+        and the 2-D vc2d mesh; everything else fails loudly BEFORE a
+        cryptic trace error."""
         app = self.app
         if getattr(app, "host_only", False):
             raise ValueError(
@@ -697,31 +698,42 @@ class Worker:
                 "MutationContext apps rebuild the fragment between "
                 "rounds and cannot share one batched dispatch"
             )
-        if app.mesh_kind != "frag":
+        if app.mesh_kind not in ("frag", "vc2d"):
             raise ValueError(
-                f"batched dispatch supports the 1-D frag mesh only "
-                f"(app mesh_kind={app.mesh_kind!r})"
+                f"batched dispatch supports the frag and vc2d meshes "
+                f"only (app mesh_kind={app.mesh_kind!r})"
             )
-        if app.custom_specs():
+        if app.custom_specs() and app.mesh_kind != "vc2d":
+            # vc2d's custom row-sharded specs are handled by
+            # _key_specs_batch; any OTHER custom layout is unaudited
             raise ValueError(
                 "batched dispatch does not support custom-spec state "
-                "leaves"
+                "leaves outside the vc2d mesh"
             )
 
     def _key_specs_batch(self, state):
         """(spec per key, keys squeezed of their axis-1 frag dim) for a
         batched carry: sharded leaves are [B, fnum, ...] split on axis
         1, replicated leaves [B, ...] everywhere, ephemeral leaves stay
-        unbatched [fnum, ...] (shared streams)."""
+        unbatched [fnum, ...] (shared streams).  Custom-spec leaves
+        (vc2d): ephemeral ones keep their per-shard layout unbatched,
+        carry ones gain the leading lane axis with the custom spec
+        shifted one dim right ([B, k*vc] rides P(None, vcrow)) — and
+        are NOT squeezed, since their local block has no unit frag
+        dim."""
         app = self.app
+        custom = app.custom_specs()
         replicated = set(app.replicated_keys)
         eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        _, shard0 = self._mesh_layout()
         specs, squeezed = {}, set()
         for k in state:
             if k in eph:
-                specs[k] = P(FRAG_AXIS)
+                specs[k] = custom.get(k, shard0)
             elif k in replicated:
                 specs[k] = P()
+            elif k in custom:
+                specs[k] = P(None, *custom[k])
             else:
                 specs[k] = P(None, FRAG_AXIS)
                 squeezed.add(k)
@@ -795,10 +807,16 @@ class Worker:
         app = self.app
         mesh, frag_spec = self._mesh_layout()
         eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        custom = frozenset(app.custom_specs())
 
         def stepper(frag_stacked, state, eph_state, squeezed):
             frag = frag_stacked.local()
-            eph_vals = {k: v[0] for k, v in eph_state.items()}
+            # custom-spec ephemeral leaves (vc2d vmask_row) arrive as
+            # their raw per-shard block — no unit frag dim to strip
+            eph_vals = {
+                k: (v if k in custom else v[0])
+                for k, v in eph_state.items()
+            }
             st = _squeeze_lane_state(state, squeezed)
             _, lane_peval, lane_inc = self._lane_stepper_parts(eph_vals)
             st, active = jax.vmap(lambda s: lane_peval(frag, s))(st)
@@ -842,11 +860,15 @@ class Worker:
         app = self.app
         mesh, frag_spec = self._mesh_layout()
         eph = frozenset(getattr(app, "ephemeral_keys", ()) or ())
+        custom = frozenset(app.custom_specs())
 
         def stepper(frag_stacked, state, eph_state, active0, rv0, r0,
                     squeezed):
             frag = frag_stacked.local()
-            eph_vals = {k: v[0] for k, v in eph_state.items()}
+            eph_vals = {
+                k: (v if k in custom else v[0])
+                for k, v in eph_state.items()
+            }
             st = _squeeze_lane_state(state, squeezed)
             _, _, lane_inc = self._lane_stepper_parts(eph_vals)
             limit = jnp.int32(max_rounds if max_rounds > 0 else _INT32_MAX)

@@ -200,6 +200,35 @@ _PARTITION2D = {
     "tile_recount_mismatch": (_NUM, True),
 }
 
+# the PR 19 pipelined-SUMMA lane (parallel/pipeline.py
+# VC2DPipelinePlan, models/vc2d.py, docs/PARTITION2D.md "Overlapped
+# round"): 2-D SSSP pipelined vs unpipelined vs the 1-D baseline,
+# byte-compared per oid; the decision record's rate-profile label and
+# modeled hidden-µs per round are REQUIRED (the lane gates on both),
+# and the wall's backend is declared so a CPU correctness proxy can
+# never read as overlap evidence.  Verdict fields are DECLARED bool.
+_VC2D_PIPELINE = {
+    "scale": (int, True),
+    "fnum": (int, True),
+    "k": (int, True),
+    "app": (str, True),
+    "engaged": (bool, True),
+    "phase_split": (int, True),
+    "edge_slots": (int, True),
+    "exchange_bytes": (int, True),
+    "serial_1d_s": (_NUM, True),
+    "serial_2d_s": (_NUM, True),
+    "pipelined_2d_s": (_NUM, True),
+    "pipelined_eq_serial_2d": (bool, True),
+    "pipelined_eq_1d": (bool, True),
+    "profile": (str, True),
+    "modeled_hidden_us": (_NUM, True),
+    "modeled_hidden_frac": (_NUM, True),
+    "measured_speedup": (_NUM, True),
+    "wall_backend": (str, True),
+    "wall_is_overlap_evidence": (bool, True),
+}
+
 # the r11 masked-SpGEMM lane (ops/spgemm_pack.py, docs/SPGEMM.md):
 # LCC intersect-vs-spgemm wall A/B at the lane geometry with the
 # bit-exactness verdict and the shipped-plan ledger recount (the 5%
@@ -389,6 +418,7 @@ _BLOCKS = {
     "dyn": _DYN,
     "pipeline": _PIPELINE,
     "partition2d": _PARTITION2D,
+    "vc2d_pipeline": _VC2D_PIPELINE,
     "spgemm": _SPGEMM,
     "fleet": _FLEET,
     "telemetry": _TELEMETRY,

@@ -684,6 +684,76 @@ def test_r9_shipped_call_sites_are_clean():
         assert not r9, (rel, [f.message for f in r9])
 
 
+# ---- R11: no raw SUMMA axis names in models/ ------------------------------
+
+
+def test_r11_trips_on_raw_axis_literal():
+    src = """
+    from jax import lax
+
+    def fold(partial):
+        return lax.pmin(partial, 'vcrow')
+    """
+    assert "R11" in _rules(src, "libgrape_lite_tpu/models/vc2d.py")
+
+
+def test_r11_trips_on_axis_tuple_literal():
+    src = """
+    SPEC = ('vcrow', 'vccol')
+    """
+    assert "R11" in _rules(src, "libgrape_lite_tpu/models/custom.py")
+
+
+def test_r11_passes_on_imported_constants():
+    src = """
+    from jax import lax
+
+    from libgrape_lite_tpu.parallel.comm_spec import (
+        VC_COL_AXIS,
+        VC_ROW_AXIS,
+    )
+
+    def fold(partial):
+        return lax.pmin(partial, VC_ROW_AXIS)
+
+    def fold_col(partial):
+        return lax.pmin(partial, VC_COL_AXIS)
+    """
+    assert "R11" not in _rules(src, "libgrape_lite_tpu/models/vc2d.py")
+
+
+def test_r11_is_scoped_to_models():
+    # the defining module and non-model layers (worker, bench) never
+    # open a collective over the axis by name — out of scope
+    src = """
+    VC_ROW_AXIS = 'vcrow'
+    VC_COL_AXIS = 'vccol'
+    """
+    assert "R11" not in _rules(
+        src, "libgrape_lite_tpu/parallel/comm_spec.py")
+    assert "R11" not in _rules(src, "libgrape_lite_tpu/worker/worker.py")
+    assert "R11" in _rules(src, "libgrape_lite_tpu/models/evil.py")
+
+
+def test_r11_shipped_models_are_clean():
+    # zero-entry baseline over the whole models/ tree
+    import glob
+    import os
+
+    import libgrape_lite_tpu
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(libgrape_lite_tpu.__file__)))
+    for path in glob.glob(
+        os.path.join(root, "libgrape_lite_tpu", "models", "*.py")
+    ):
+        rel = os.path.relpath(path, root)
+        with open(path) as fh:
+            src = fh.read()
+        r11 = [f for f in lint_source(src, rel) if f.rule == "R11"]
+        assert not r11, (rel, [f.message for f in r11])
+
+
 # ---- baseline round-trip --------------------------------------------------
 
 

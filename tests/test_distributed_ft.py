@@ -161,6 +161,34 @@ def test_stage_failure_fails_every_rank(graph_cache, tmp_path):
         mgr.save_async(_state(frag), rounds=2, active=3)
 
 
+def test_vc2d_sharded_checkpoint_reads_host_only(tmp_path):
+    """The PR 18 device-read bug class, audited on the 2-D path: the
+    sharded manager's whole cycle — content fingerprint, stage/commit,
+    restore — must run with the vertex-cut device tiles DELETED, the
+    single-process stand-in for a jax.distributed mesh where those
+    tiles span non-addressable devices and any fetch would throw."""
+    from tests.test_partition2d import _vc_frag
+
+    from libgrape_lite_tpu.ft.checkpoint import restore_latest
+    from libgrape_lite_tpu.ft.fingerprint import fragment_content_hash
+
+    frag = _vc_frag(4, weighted=True)
+    fp_resident = fragment_content_hash(frag)
+    assert frag.release_device() is True
+    assert fragment_content_hash(frag) == fp_resident
+
+    rng = np.random.default_rng(1)
+    state = {
+        "dist": rng.random((frag.fnum, frag.vp)).astype(np.float64)
+    }
+    mgr = _mgr(tmp_path / "ck", frag)
+    mgr.save_async(state, rounds=2, active=3)
+    restored, meta = restore_latest(str(tmp_path / "ck"), {"app": "t"})
+    assert meta["rounds"] == 2
+    np.testing.assert_array_equal(restored["dist"], state["dist"])
+    assert frag.restore_device() is True
+
+
 def test_replicated_leaf_divergence_is_corrupt(tmp_path):
     """A 'replicated' leaf must be byte-identical in every rank's shard
     file; a rank-divergent copy is a CorruptCheckpointError, never a

@@ -231,13 +231,93 @@ def test_pagerank_pack_sum_declines(monkeypatch):
     assert "sum fold" in PIPELINE_STATS["last_decision"]["reason"]
 
 
-def test_wcc_directed_declines(monkeypatch):
-    """Directed WCC pulls oe against the ie-folded labels mid-round —
-    a dependent second exchange the double buffer cannot hide."""
-    frag = _rand_frag(2, directed=True)
+@pytest.mark.parametrize("fnum", [2, 4])
+def test_wcc_directed_two_kickoff_identity(fnum, monkeypatch):
+    """Directed WCC pipelines via the two-kickoff double-pull round:
+    the oe exchange kicks from the ie BOUNDARY fold (complete at every
+    remotely-read row under the joint ie+oe mask) and hides under the
+    ie interior fold; the next round's ie exchange kicks from the oe
+    boundary fold symmetrically.  Byte-identical to the serial
+    two-pull round."""
+    frag = _rand_frag(fnum, directed=True)
     serial, _, _ = _run("wcc", frag, monkeypatch, "0")
     piped, _, app = _run("wcc", frag, monkeypatch, "force")
+    assert app._pipeline is not None
+    assert app._pipeline.mode2 is not None
+    assert piped == serial
+
+
+def test_wcc_directed_pack_declines(monkeypatch):
+    """The double-pull round over the pack backend would need four
+    sub-plans whose fold order is unaudited — directed WCC + pack
+    declines (recorded) and stays byte-identical serially."""
+    frag = _rand_frag(2, directed=True)
+    serial, _, _ = _run("wcc", frag, monkeypatch, "0",
+                        GRAPE_SPMV="pack")
+    piped, _, app = _run("wcc", frag, monkeypatch, "force",
+                         GRAPE_SPMV="pack")
     assert app._pipeline is None
+    assert piped == serial
+    from libgrape_lite_tpu.parallel.pipeline import PIPELINE_STATS
+
+    assert "double-pull" in PIPELINE_STATS["last_decision"]["reason"]
+
+
+@pytest.mark.parametrize(
+    "hook", ["default", "wide", "dynamic", "dynamic_tight"]
+)
+def test_cdlp_pipelined_identity(hook, monkeypatch):
+    """CDLP's mode fold pipelines (boundary fold -> kickoff ->
+    interior fold hides the label exchange): byte-identical to serial
+    on EVERY sort branch — packed-u32, forced-wide variadic, dynamic
+    compression, and the dynamic wide fallback under a tight universe
+    budget.  The fold only groups edges of equal destination row, so
+    any edge subset closed over rows reproduces the full fold's
+    per-row mode exactly."""
+    from libgrape_lite_tpu.worker.worker import Worker
+    from libgrape_lite_tpu.models import CDLP
+
+    frag = _rand_frag(4)
+
+    def run(pipeline):
+        monkeypatch.setenv("GRAPE_PIPELINE", pipeline)
+        app = CDLP()
+        if hook == "wide":
+            app._force_wide = True
+        elif hook.startswith("dynamic"):
+            app._force_dynamic = True
+            if hook == "dynamic_tight":
+                app._u_budget_override = 16  # << live labels: wide arm
+        w = Worker(app, frag)
+        w.query(max_round=10)
+        return w.result_values().tobytes(), w.rounds, app
+
+    serial, rounds_s, _ = run("0")
+    piped, rounds_p, app = run("force")
+    assert app._pipeline is not None
+    assert piped == serial
+    assert rounds_p == rounds_s
+
+
+@pytest.mark.parametrize("directed", [False, True])
+def test_cdlp_opt_pipelined_identity(directed, monkeypatch):
+    """CDLPOpt inherits the pipelined round (only its serial first
+    round differs); directed CDLP pulls oe only, so one kickoff
+    suffices on either graph form."""
+    from libgrape_lite_tpu.worker.worker import Worker
+    from libgrape_lite_tpu.models import CDLPOpt
+
+    frag = _rand_frag(4, directed=directed)
+
+    def run(pipeline):
+        monkeypatch.setenv("GRAPE_PIPELINE", pipeline)
+        w = Worker(CDLPOpt(), frag)
+        w.query(max_round=10)
+        return w.result_values().tobytes(), w.app
+
+    serial, _ = run("0")
+    piped, app = run("force")
+    assert app._pipeline is not None
     assert piped == serial
 
 
