@@ -393,6 +393,8 @@ def pipeline_lane(scale: int) -> dict:
     2-device CPU subprocess otherwise (`bench.py --pipeline-lane N`)."""
     import jax
 
+    from libgrape_lite_tpu import obs
+    from libgrape_lite_tpu.obs import truth
     from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
     from libgrape_lite_tpu.parallel.comm_spec import CommSpec
     from libgrape_lite_tpu.utils.types import LoadStrategy
@@ -406,6 +408,11 @@ def pipeline_lane(scale: int) -> dict:
     fnum = min(jax.device_count(), 4)
     if fnum < 2:
         raise RuntimeError("pipeline lane needs >= 2 devices")
+    if not obs.armed():
+        # the --pipeline-lane subprocess entrypoint skips main()'s
+        # arming, and the overlap truth meter below joins the tracer's
+        # measured device waits against the plan's modeled claim
+        obs.configure(in_memory=True)
     n, src, dst = rmat_edges(scale, EDGE_FACTOR)
     comm_spec = CommSpec(fnum=fnum)
     oids = np.arange(n, dtype=np.int64)
@@ -456,6 +463,7 @@ def pipeline_lane(scale: int) -> dict:
         "app": "sssp",
         "engaged": plan is not None,
         "mode": plan.mode if plan is not None else "none",
+        "plan_uid": plan.uid if plan is not None else "-",
         "serial_s": round(t_serial, 4),
         "pipelined_s": round(t_pipe, 4),
         "byte_identical": bytes_pipe == bytes_serial,
@@ -482,6 +490,17 @@ def pipeline_lane(scale: int) -> dict:
         block["overlap_recount_mismatch"] = (
             overlap_recount(plan)["overlap_recount_mismatch"]
         )
+    # the overlap truth meter (obs/truth.py): join the pipelined
+    # queries this lane just ran against the tracer's measured
+    # device waits, per plan uid — the modeled hidden_us claim is
+    # reconciled here instead of shipping unaudited.  Joined rows
+    # also feed the calibration harvest (GRAPE_CALIBRATE_HARVEST).
+    rep = truth.truth_report(obs.history())
+    block["overlap_truth"] = truth.block_brief(rep)
+    truth.harvest_report(
+        rep,
+        pipe_brief=plan.span_brief() if plan is not None else None,
+    )
     return block
 
 
@@ -782,6 +801,7 @@ def vc2d_pipeline_lane(scale: int) -> dict:
         ),
         "pipelined_eq_1d": res_p2d.tobytes() == res_1d.tobytes(),
         "profile": str(dec.get("profile", "")),
+        "plan_uid": str(dec.get("plan_uid", "-")),
         "modeled_hidden_us": float(dec.get("modeled_hidden_us", -1.0)),
         "modeled_hidden_frac": float(
             brief.get("modeled_hidden_frac", 0.0)),
@@ -814,6 +834,109 @@ def _vc2d_pipeline_lane_subprocess(scale: int) -> dict:
             f"{r.stderr.strip()[-500:]}"
         )
     return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def obs_gang_lane() -> dict:
+    """The gang-telemetry self-drill (PR 20; obs/gang.py,
+    docs/OBSERVABILITY.md "Gang-wide telemetry").  The bench is a
+    single process, so the lane builds the gang in-process: two fake
+    rank tracers (the constructor rank/nprocs fallback) each record a
+    superstep span and one leg of a breach-vote flow, write real
+    sidecars into a scratch `.gang` dir with an injected clock
+    handshake (rank 1's clock deliberately skewed), and the rank-0
+    assembler must merge them into one complete, aligned, monotonic
+    timeline with the vote arrow crossing both rank tracks — the same
+    code path `trace_report --gang` and the fault drill run.
+
+    The second leg re-proves the PR 15 invariant at bench time: the
+    fused runner's lowered HLO must be byte-identical armed vs
+    disarmed (tracing is a host-side decision; gang stamping is gated
+    on nprocs > 1 and must never reach the compiled program)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from libgrape_lite_tpu import obs
+    from libgrape_lite_tpu.obs import gang
+    from libgrape_lite_tpu.obs.tracer import Tracer
+
+    # -- two-rank sidecar federation ----------------------------------
+    tracers = [Tracer(enabled=True, rank=r, nprocs=2) for r in (0, 1)]
+    # rank 1's monotonic clock reads 2.5ms ahead of rank 0's: the
+    # assembler must shift it back or the merged order interleaves
+    offsets = {"0": 0, "1": -2_500_000}
+    hs = {"nprocs": 2, "offsets_ns": offsets, "allgather_wall_ns": 0}
+    for r, t in enumerate(tracers):
+        with t.span("superstep", round=1):
+            pass
+        t.flow("breach_vote", flow_id=1, cat="gang-vote",
+               phase="s" if r == 0 else "f", round=1)
+    wd = tempfile.mkdtemp(prefix="grape_obs_gang_")
+    try:
+        gdir = os.path.join(wd, "trace.gang")
+        for r, t in enumerate(tracers):
+            gang.write_sidecar(
+                tracer=t, handshake=dict(hs, rank=r),
+                path=os.path.join(gdir, f"rank_{r}.json"),
+                events=t.events(),
+            )
+        summary = gang.assemble(
+            gdir, out_path=os.path.join(wd, "merged.json"))
+    finally:
+        shutil.rmtree(wd, ignore_errors=True)
+
+    # -- armed-vs-disarmed fused-HLO identity -------------------------
+    from libgrape_lite_tpu.fragment.edgecut import ShardedEdgecutFragment
+    from libgrape_lite_tpu.models import SSSP
+    from libgrape_lite_tpu.parallel.comm_spec import CommSpec
+    from libgrape_lite_tpu.utils.types import LoadStrategy
+    from libgrape_lite_tpu.vertex_map.partitioner import (
+        SegmentedPartitioner,
+    )
+    from libgrape_lite_tpu.vertex_map.vertex_map import VertexMap
+    from libgrape_lite_tpu.worker.worker import Worker
+
+    n = 32
+    src = np.arange(n - 1, dtype=np.int64)
+    dst = src + 1
+    wts = np.ones(n - 1, np.float32)
+    oids = np.arange(n, dtype=np.int64)
+    fnum = min(jax.device_count(), 2)
+    vm = VertexMap.build(oids, SegmentedPartitioner(fnum, oids))
+    frag = ShardedEdgecutFragment.build(
+        CommSpec(fnum=fnum), vm, src, dst, wts, directed=False,
+        load_strategy=LoadStrategy.kBothOutIn,
+    )
+
+    def lowered_text():
+        w = Worker(SSSP(), frag)
+        state = w._place_state(w.app.init_state(frag, source=0))
+        eph = frozenset(getattr(w.app, "ephemeral_keys", ()) or ())
+        carry = {k: v for k, v in state.items() if k not in eph}
+        eph_part = {k: v for k, v in state.items() if k in eph}
+        runner = w._make_runner(0)(state)
+        return jax.jit(runner).lower(frag.dev, carry, eph_part).as_text()
+
+    armed_txt = lowered_text()  # main() armed obs at the top
+    obs.reset()
+    disarmed_txt = lowered_text()
+    # re-arm: env sinks re-resolve lazily, else back to in-memory
+    if os.environ.get(obs.TRACE_ENV) or os.environ.get(obs.METRICS_ENV):
+        obs.tracer()
+    else:
+        obs.configure(in_memory=True)
+
+    return {
+        "ranks": len(summary["ranks"]),
+        "events": int(summary["events"]),
+        "flow_events": int(summary["flow_events"]),
+        "cross_rank_flows": int(summary["cross_rank_flows"]),
+        "aligned": bool(summary["aligned"]),
+        "monotonic": bool(summary["monotonic"]),
+        "complete": bool(summary["complete"]),
+        "hlo_identical": armed_txt == disarmed_txt,
+    }
 
 
 # measured walls within this band of each other count as agreeing
@@ -2396,8 +2519,10 @@ def main():
     # (rates + residual + fallback notes) so PERF_NOTES can table
     # pinned-vs-fitted.  GRAPE_BENCH_NO_CALIBRATION=1 skips.
     calibration_mismatch = None
+    truth_mismatch = None
     if not os.environ.get("GRAPE_BENCH_NO_CALIBRATION"):
         try:
+            from libgrape_lite_tpu.obs import truth
             from libgrape_lite_tpu.ops import calibration as calib
 
             spath = os.environ.get("GRAPE_CALIBRATION_SAMPLES")
@@ -2419,6 +2544,13 @@ def main():
                 fitted_prof = prof
                 notes = [f"fit failed: {e}"]
                 residual_pct = -1.0
+            # the overlap truth meter over THIS process's span history
+            # (the pipeline lane reconciles its own run; this row
+            # covers any pipelined query the main bench dispatched).
+            # Informational on the CPU-fallback host; gated below only
+            # under an explicit GRAPE_RATE_PROFILE — same condition as
+            # the rate-drift gate, and for the same reason.
+            trep = truth.truth_report(obs.history())
             record["calibration"] = {
                 "profile": prof.label(),
                 "fingerprint": calib.backend_fingerprint(),
@@ -2443,10 +2575,13 @@ def main():
                 "unfitted": sorted(fitted_prof.unfitted),
                 "fallback_notes": notes,
                 "surfaces": rep["surfaces"],
+                "overlap_truth": truth.block_brief(trep),
             }
             _emit_record(record)
             if os.environ.get(calib.PROFILE_ENV) and not rep["drift_ok"]:
                 calibration_mismatch = rep["drift_pct"]
+            if os.environ.get(calib.PROFILE_ENV) and not trep["ok"]:
+                truth_mismatch = trep["max_claim_frac"]
         except Exception as e:  # the lane must not cost the bench
             print(
                 f"[bench] calibration lane failed: "
@@ -2500,6 +2635,51 @@ def main():
     except Exception as e:  # the obs lane must not cost the bench
         print(f"[bench] obs lane failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+
+    # gang-telemetry lane (PR 20, obs/gang.py): the in-process
+    # two-rank sidecar federation drill plus the armed-vs-disarmed
+    # fused-HLO identity re-proof.  Runs AFTER the obs rollup: the
+    # HLO leg has to fully disarm (obs.reset), which drops the span
+    # history the rollup reads.  GRAPE_BENCH_NO_OBS_GANG=1 skips.
+    obs_gang_mismatch = None
+    if not os.environ.get("GRAPE_BENCH_NO_OBS_GANG"):
+        try:
+            og = obs_gang_lane()
+            record["obs_gang"] = og
+            _emit_record(record)
+            print(
+                f"[bench] obs_gang: ranks={og['ranks']} "
+                f"events={og['events']} cross_rank_flows="
+                f"{og['cross_rank_flows']} complete={og['complete']} "
+                f"monotonic={og['monotonic']} "
+                f"hlo_identical={og['hlo_identical']}",
+                file=sys.stderr,
+            )
+            if not og["complete"]:
+                obs_gang_mismatch = (
+                    "the merged gang trace is incomplete (missing "
+                    "rank, unaligned clocks, or a span-less rank)"
+                )
+            elif og["cross_rank_flows"] < 1:
+                obs_gang_mismatch = (
+                    "no flow arrow crossed the rank tracks — the "
+                    "vote legs lost their shared (cat, id)"
+                )
+            elif not og["monotonic"]:
+                obs_gang_mismatch = (
+                    "post-alignment timestamps are not monotonic"
+                )
+            elif not og["hlo_identical"]:
+                obs_gang_mismatch = (
+                    "arming the tracer changed the fused runner's "
+                    "lowered HLO — tracing leaked into the program"
+                )
+        except Exception as e:  # the lane must not cost the bench
+            print(
+                f"[bench] obs_gang lane failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
 
     if ledger_mismatch is not None:
         print(
@@ -2566,6 +2746,23 @@ def main():
             f"{calibration_mismatch:.1f}% (> 5%) from measured device "
             "walls — recalibrate (python -m libgrape_lite_tpu.cli "
             "calibrate) or unset the stale profile",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    if truth_mismatch is not None:
+        print(
+            f"[bench] FATAL: the modeled overlap claim is "
+            f"{truth_mismatch:.2f}x the measured round wall (> the "
+            "claim limit) — the pipeline model claims to hide more "
+            "exchange than the round took; see calibration."
+            "overlap_truth above",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    if obs_gang_mismatch is not None:
+        print(
+            f"[bench] FATAL: obs_gang lane verdict failed: "
+            f"{obs_gang_mismatch} — see the obs_gang block above",
             file=sys.stderr,
         )
         sys.exit(2)

@@ -1249,13 +1249,110 @@ def _check_r11(module: _Scope, path: str,
             ))
 
 
+#: a dict key that states a MODELED overlap claim (the planner's
+#: side of the truth-meter join)
+_R12_MODELED_RE = re.compile(r"^(modeled_|hidden_us)")
+
+#: the sanctioned correlation keys the truth meter joins on
+_R12_JOIN_KEYS = ("plan_uid", "trace_key")
+
+
+def _r12_scopes(tree: ast.AST):
+    """Module + every function def, each walked WITHOUT descending
+    into nested function bodies (those are their own scopes)."""
+    def shallow(node):
+        for c in ast.iter_child_nodes(node):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield c
+            yield from shallow(c)
+
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.Module, ast.FunctionDef,
+                          ast.AsyncFunctionDef)):
+            yield n, list(shallow(n))
+
+
+def _r12_str_keys(d: ast.Dict):
+    return {k.value for k in d.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+
+
+def _check_r12(module: _Scope, path: str,
+               findings: List[Finding]) -> None:
+    """R12 unkeyed-modeled-claim.  A dict that carries a modeled
+    overlap claim (``modeled_*`` / ``hidden_us*`` key) next to an
+    ``engaged`` verdict is a pipeline/2-D decision record or span
+    brief — the exact records obs/truth.py joins against measured
+    device waits, and the join key is ``plan_uid`` (or ``trace_key``)
+    riding in the SAME record.  Two forms are audited per scope: a
+    dict literal holding both keys inline, and a name bound to a dict
+    literal whose claim/verdict keys arrive via later subscript
+    assignments (the decision-record idiom in parallel/pipeline.py).
+    The union of literal + subscript-assigned keys must include a
+    correlation key."""
+    for _, nodes in _r12_scopes(module.node):
+        # (a) self-contained literals (span_brief-style records)
+        literal_of: dict = {}
+        keys_of: dict = {}
+        first_line: dict = {}
+        bound_literals: set = set()
+        for n in nodes:
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Dict):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        literal_of[t.id] = n
+                        keys_of.setdefault(t.id, set()).update(
+                            _r12_str_keys(n.value))
+                        first_line.setdefault(t.id, n.lineno)
+                        # audited via the key-union path below, where
+                        # a later subscript may supply the join key
+                        bound_literals.add(id(n.value))
+            elif isinstance(n, ast.Dict) and id(n) not in bound_literals:
+                keys = _r12_str_keys(n)
+                if ("engaged" in keys
+                        and any(_R12_MODELED_RE.match(k) for k in keys)
+                        and not any(j in keys for j in _R12_JOIN_KEYS)):
+                    findings.append(Finding(
+                        "R12", path, n.lineno, "<dict>",
+                        "modeled overlap claim next to an `engaged` "
+                        "verdict without a plan_uid/trace_key — the "
+                        "overlap truth meter cannot join this record "
+                        "against measured device waits; stamp the "
+                        "plan uid into the same dict",
+                    ))
+            elif (isinstance(n, ast.Assign)
+                  and len(n.targets) == 1
+                  and isinstance(n.targets[0], ast.Subscript)
+                  and isinstance(n.targets[0].value, ast.Name)
+                  and isinstance(n.targets[0].slice, ast.Constant)
+                  and isinstance(n.targets[0].slice.value, str)):
+                name = n.targets[0].value.id
+                keys_of.setdefault(name, set()).add(
+                    n.targets[0].slice.value)
+        # (b) decision-record idiom: literal + subscript assignments
+        for name, node in literal_of.items():
+            keys = keys_of.get(name, set())
+            if ("engaged" in keys
+                    and any(_R12_MODELED_RE.match(k) for k in keys)
+                    and not any(j in keys for j in _R12_JOIN_KEYS)):
+                findings.append(Finding(
+                    "R12", path, first_line[name], name,
+                    f"decision record {name!r} claims modeled overlap "
+                    "(modeled_*/hidden_us* key) next to `engaged` but "
+                    "never stamps plan_uid/trace_key in this scope — "
+                    "the truth meter cannot join the claim against "
+                    "measured device waits",
+                ))
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
 
 def lint_source(src: str, relpath: str) -> List[Finding]:
-    """All R1-R11 findings for one module's source text."""
+    """All R1-R12 findings for one module's source text."""
     relpath = relpath.replace(os.sep, "/")
     try:
         tree = ast.parse(src)
@@ -1283,6 +1380,7 @@ def lint_source(src: str, relpath: str) -> List[Finding]:
     _check_r9(module, relpath, findings)
     _check_r10(module, relpath, findings)
     _check_r11(module, relpath, findings)
+    _check_r12(module, relpath, findings)
     return findings
 
 

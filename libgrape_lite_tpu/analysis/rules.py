@@ -173,6 +173,22 @@ RULES: Dict[str, Rule] = {
             "fossilized out of models/ (zero-entry baseline)",
         ),
         Rule(
+            "R12", "unkeyed-modeled-claim",
+            "a decision/brief dict that carries a modeled overlap "
+            "claim (a modeled_* or hidden_us* key) next to an "
+            "`engaged` verdict does not also carry the correlation "
+            "key (`plan_uid` or `trace_key`) — the overlap truth "
+            "meter (obs/truth.py) cannot join the claim against the "
+            "tracer's measured device waits, so the modeled headline "
+            "is unauditable",
+            "PR 20 (preventive): every pipeline/2-D engagement "
+            "headline in this tree is modeled, and until the truth "
+            "meter landed nothing reconciled the claims against "
+            "measured walls; the join hangs entirely on the plan uid "
+            "riding in the same record, so an unkeyed claim is "
+            "fossilized out (zero-entry baseline)",
+        ),
+        Rule(
             "A1", "constant-bloat",
             "the lowered HLO of a fused runner holds a literal "
             "constant above the byte threshold — an R1 escape "

@@ -136,6 +136,41 @@ def _sha_prefix(sha_hex: str) -> Tuple[int, int]:
     return int(sha_hex[:7], 16), int(sha_hex[7:14], 16)
 
 
+def _obs_trace_word() -> int:
+    """28-bit trace-id prefix riding the 2PC vote vectors (0 when obs
+    is disarmed) — the allgathered matrix then correlates every rank's
+    trace file with this commit.  Readers tolerate its absence: fakes
+    that allgather 4-wide stage votes keep working because nothing
+    reads past the columns it already had."""
+    try:
+        from libgrape_lite_tpu.obs.gang import trace_word
+
+        return trace_word()
+    except Exception:
+        return 0
+
+
+def _ckpt_flow(comm, rounds: int, leg: str) -> None:
+    """One flow-event leg per 2PC phase barrier: every rank shares
+    `(cat="gang-ckpt", id=rounds+1)` so the merged gang trace renders
+    stage→commit as one arrow across the rank tracks.  Never raises;
+    two-branch no-op disarmed."""
+    try:
+        from libgrape_lite_tpu import obs
+
+        tr = obs.tracer()
+        if not tr.enabled:
+            return
+        if leg == "stage":
+            phase = "s" if comm.rank == 0 else "t"
+        else:
+            phase = "f" if comm.rank == comm.nprocs - 1 else "t"
+        tr.flow(f"ckpt_{leg}", flow_id=int(rounds) + 1, phase=phase,
+                cat="gang-ckpt", round=int(rounds))
+    except Exception:
+        pass
+
+
 def _maybe_kill_between_phases(rounds: int, rank: int) -> None:
     spec = os.environ.get(TWO_PHASE_KILL_ENV, "")
     if not spec:
@@ -283,8 +318,10 @@ class ShardedCheckpointManager:
             ok, stage_err = 0, e  # a local failure into a gang-wide one
         lo, hi = _sha_prefix(sha_hex)
         votes = self.comm.allgather(
-            np.asarray([ok, rounds, lo, hi], np.int32)
+            np.asarray([ok, rounds, lo, hi, _obs_trace_word()],
+                       np.int32)
         )
+        _ckpt_flow(self.comm, rounds, "stage")
         if not np.all(votes[:, 0] == 1):
             bad = np.nonzero(votes[:, 0] != 1)[0].tolist()
             raise CorruptCheckpointError(
@@ -304,8 +341,10 @@ class ShardedCheckpointManager:
             except Exception as e:
                 committed, commit_err = 0, e
         done = self.comm.allgather(
-            np.asarray([committed, rounds], np.int32)
+            np.asarray([committed, rounds, _obs_trace_word()],
+                       np.int32)
         )
+        _ckpt_flow(self.comm, rounds, "commit")
         if not np.all(done[:, 0] == 1):
             raise CorruptCheckpointError(
                 f"two-phase commit failed in the commit phase at "

@@ -25,6 +25,18 @@ checkpointing, or an injected fault plan — all env/flag-symmetric
 across ranks) and only under `jax.process_count() > 1`, so
 single-process behavior is bit-identical with the module never
 imported.
+
+Gang-telemetry riders (PR 20): when obs is armed, each vote vector
+carries a third int32 — a 28-bit prefix of this rank's trace id — so
+the allgathered matrix correlates every rank's trace file; each rank
+also emits one flow-event leg per vote (shared `(cat, id)` =
+`("gang-vote", rounds+1)`), so the merged gang trace renders the vote
+as an arrow across rank tracks.  Every raise path attaches
+`err.gang_incident`, a deterministic digest of the allgathered vote
+content — identical bytes on every rank, so the gang agrees on one
+incident id with no extra message (obs/gang.py dumps the distributed
+postmortem under it).  Fakes that allgather 2-wide vectors keep
+working: the extra column is read only when present.
 """
 
 from __future__ import annotations
@@ -58,7 +70,20 @@ class RemoteBreachError(GuardError):
     """Another rank voted a halt at this superstep; this rank is
     healthy and halts in lockstep instead of blocking in the next
     collective.  `.bundle` names the voting ranks and their verdict
-    codes."""
+    codes; `.gang_incident` (when set) is the gang-shared incident id
+    the distributed flight recorder dumps under."""
+
+
+def _trace_word() -> int:
+    """28-bit prefix of this rank's trace id (0 disarmed) — rides the
+    int32 vote vector so the allgathered matrix names every rank's
+    trace file."""
+    try:
+        from libgrape_lite_tpu.obs.gang import trace_word
+
+        return trace_word()
+    except Exception:
+        return 0
 
 
 def classify_breach_error(err: Optional[BaseException]) -> int:
@@ -110,35 +135,80 @@ class BreachVote:
             return None
         return cls()
 
+    def _incident(self, votes, rounds: int) -> Optional[str]:
+        """Deterministic gang-shared incident id over the allgathered
+        vote matrix (identical bytes on every rank)."""
+        try:
+            from libgrape_lite_tpu.obs.gang import incident_id
+
+            return incident_id({
+                "kind": "breach_vote",
+                "rounds": int(rounds),
+                "votes": [[int(x) for x in row]
+                          for row in np.asarray(votes).tolist()],
+            })
+        except Exception:
+            return None
+
+    def _emit_flow(self, rounds: int, halted: bool) -> None:
+        """One flow-event leg for this vote: every rank shares
+        `(cat="gang-vote", id=rounds+1)`, so the merged gang trace
+        draws the vote as one arrow across the rank tracks."""
+        try:
+            from libgrape_lite_tpu import obs
+
+            tr = obs.tracer()
+            if not tr.enabled:
+                return
+            phase = ("s" if self.rank == 0 else
+                     "f" if self.rank == self.nprocs - 1 else "t")
+            tr.flow("breach_vote", flow_id=int(rounds) + 1,
+                    phase=phase, cat="gang-vote",
+                    round=int(rounds), halted=bool(halted))
+        except Exception:
+            pass
+
     def round_vote(self, rounds: int,
                    err: Optional[BaseException] = None) -> None:
         """Exchange this superstep's verdict with every rank.  Always
         raises when any rank (this one included) voted unhealthy:
         `err` re-raised locally, `RemoteBreachError` on healthy ranks.
-        Returns normally only on a unanimous healthy vote."""
+        Returns normally only on a unanimous healthy vote.  Every
+        raised (or re-raised) error carries `.gang_incident`."""
         code = classify_breach_error(err)
         votes = np.asarray(self._allgather(
-            np.asarray([code, int(rounds)], np.int32)
+            np.asarray([code, int(rounds), _trace_word()], np.int32)
         ))
         if votes.shape[0] != self.nprocs:
-            raise RemoteBreachError(
+            e = RemoteBreachError(
                 f"breach vote returned {votes.shape[0]} rows for "
                 f"{self.nprocs} processes",
                 {"rounds": int(rounds)},
             )
+            e.gang_incident = self._incident(votes, rounds)
+            raise e
+        codes = votes[:, 0]
+        rds = votes[:, 1]
+        healthy = (err is None and np.all(rds == int(rounds))
+                   and not np.any(codes != VOTE_HEALTHY))
+        self._emit_flow(rounds, halted=not healthy)
         if err is not None:
             # every sibling saw the vote and is halting too; the
             # breaching rank keeps its own (more specific) error
+            try:
+                err.gang_incident = self._incident(votes, rounds)
+            except Exception:  # exotic errors may reject attributes
+                pass
             raise err
-        codes = votes[:, 0]
-        rds = votes[:, 1]
         if not np.all(rds == int(rounds)):
-            raise RemoteBreachError(
+            e = RemoteBreachError(
                 "breach vote out of lockstep: per-rank supersteps "
                 f"{rds.tolist()} (this rank {self.rank} at "
                 f"{int(rounds)})",
                 {"rounds": rds.tolist(), "codes": codes.tolist()},
             )
+            e.gang_incident = self._incident(votes, rounds)
+            raise e
         bad = np.nonzero(codes != VOTE_HEALTHY)[0]
         if bad.size:
             detail = ", ".join(
@@ -146,7 +216,7 @@ class BreachVote:
                 f"{_VOTE_NAMES.get(int(codes[r]), int(codes[r]))}"
                 for r in bad
             )
-            raise RemoteBreachError(
+            e = RemoteBreachError(
                 f"halt voted at superstep {int(rounds)}: {detail} "
                 f"(this rank {self.rank} is healthy and halts in "
                 "lockstep)",
@@ -156,3 +226,5 @@ class BreachVote:
                     "codes": [int(codes[r]) for r in bad],
                 },
             )
+            e.gang_incident = self._incident(votes, rounds)
+            raise e

@@ -183,6 +183,10 @@ class VC2DMinAppBase(GatherScatterAppBase):
         self._pipeline_uid = (
             self._pipeline.uid if self._pipeline is not None else "-"
         )
+        # the truth meter joins measured device waits against modeled
+        # overlap by plan uid; the partition record is how the 2-D
+        # path's key reaches the obs partition surface
+        self._partition_stats["plan_uid"] = self._pipeline_uid
         state.update(eph_entries)
         self.ephemeral_keys = frozenset(eph_entries)
         return state

@@ -136,6 +136,12 @@ def reset() -> None:
         _state["metrics_path"] = None
         _state["in_memory"] = False
         _state["chrome_history"] = []
+    try:
+        from libgrape_lite_tpu.obs import gang
+
+        gang.reset()  # forget the cached clock handshake with the rest
+    except Exception:
+        pass
 
 
 def tracer() -> Tracer:
@@ -193,6 +199,16 @@ def flush() -> dict:
             json_path=mp + ".json", prom_path=mp + ".prom"
         )
         out["metrics"] = mp
+    if _state["trace_path"] and tr.nprocs > 1:
+        # gang runs also rewrite this rank's sidecar so the rank-0
+        # assembler (trace_report --gang) sees everything flushed so
+        # far; single-process flushes never touch the gang dir
+        try:
+            from libgrape_lite_tpu.obs import gang
+
+            gang.write_sidecar()
+        except Exception:
+            pass
     return out
 
 

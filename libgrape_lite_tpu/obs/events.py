@@ -21,6 +21,12 @@ Event kinds (the `ph` phase tag):
   names each process `grape/r<rank>` and maps host threads and
   per-fragment tracks (`frag/<fid>`) to distinct `tid` rows so a
   multi-fragment mesh renders as parallel tracks.
+* ``s``/``t``/``f`` — flow events: start / step / end of a cross-track
+  arrow.  All three phases of one flow share `(cat, id)`; Perfetto
+  draws the arrow between the enclosing slices.  The gang layer
+  (obs/gang.py) uses flows to render a breach vote or a checkpoint
+  stage→commit sequence ACROSS rank process-tracks in the merged
+  trace — the Dapper-style correlation id is the flow `id`.
 
 Timestamps are integer nanoseconds internally (`time.perf_counter_ns`,
 monotonic) and microseconds-with-remainder on export, Chrome's unit.
@@ -91,6 +97,33 @@ def counter_event(name: str, *, ts_ns: int, pid: int, tid: int,
         "tid": tid,
         "args": dict(values),
     }
+
+
+def flow_event(name: str, *, ts_ns: int, pid: int, tid: int,
+               flow_id: int, phase: str,
+               args: Dict[str, Any] | None = None,
+               cat: str = "gang") -> Dict[str, Any]:
+    """One leg of a cross-track flow arrow.  `phase` is "s" (start),
+    "t" (step) or "f" (end); every leg of one arrow must share
+    `(cat, flow_id)`.  The end leg carries `bp: "e"` so Perfetto binds
+    it to the ENCLOSING slice rather than the next one (the vote flow
+    should land on the superstep that halted, not whatever follows)."""
+    if phase not in ("s", "t", "f"):
+        raise ValueError(f"flow phase must be s/t/f, got {phase!r}")
+    ev = {
+        "ph": phase,
+        "name": name,
+        "cat": cat,
+        "id": int(flow_id),
+        "ts": ts_ns / 1000.0,
+        "pid": pid,
+        "tid": tid,
+    }
+    if phase == "f":
+        ev["bp"] = "e"
+    if args:
+        ev["args"] = args
+    return ev
 
 
 def metadata_event(kind: str, *, pid: int, tid: int = 0,

@@ -68,6 +68,16 @@ def federation_text(snap=None) -> str:
     if snap is None:
         snap = federation.snapshot()
     lines = []
+    from libgrape_lite_tpu.obs.metrics import gang_identity
+
+    rank, nprocs = gang_identity()
+    if nprocs > 1:
+        # gang identity gauges: which rank this scrape came from
+        # (single-process text stays byte-identical to pre-gang)
+        lines.append("# TYPE grape_gang_rank gauge")
+        lines.append(f"grape_gang_rank {rank}")
+        lines.append("# TYPE grape_gang_nprocs gauge")
+        lines.append(f"grape_gang_nprocs {nprocs}")
     lines.append("# TYPE grape_stats_registry gauge")
     for ns in sorted(snap):
         lines.append(
@@ -126,10 +136,17 @@ class _Handler(BaseHTTPRequestHandler):
                 ).encode("utf-8")
                 self._send(200, body, "application/json")
             elif path == "/healthz":
-                body = json.dumps({
+                from libgrape_lite_tpu.obs.metrics import gang_identity
+
+                health = {
                     "ok": True,
                     "namespaces": len(federation.registered()),
-                }).encode("utf-8")
+                }
+                rank, nprocs = gang_identity()
+                if nprocs > 1:
+                    health["rank"] = rank
+                    health["nprocs"] = nprocs
+                body = json.dumps(health).encode("utf-8")
                 self._send(200, body, "application/json")
             else:
                 self._send(404, b"not found\n", "text/plain")

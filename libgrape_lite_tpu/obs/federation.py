@@ -53,6 +53,7 @@ EXPECTED: Dict[str, str] = {
     "recorder": "libgrape_lite_tpu.obs.recorder",
     "autopilot": "libgrape_lite_tpu.autopilot.signals",
     "vc_tiles": "libgrape_lite_tpu.fragment.vertexcut",
+    "gang": "libgrape_lite_tpu.obs.gang",
 }
 
 

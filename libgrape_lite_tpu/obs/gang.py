@@ -1,0 +1,516 @@
+"""Gang-wide observability: sidecars, clock handshake, assembler,
+and the distributed flight recorder.
+
+The single-process telemetry plane (tracer -> Chrome trace, federation
+-> scrape) observes exactly one rank; the gang machinery it should be
+watching (guard/vote.py breach votes, ft/distributed.py 2PC
+checkpoints) is multi-rank.  This module closes the gap with three
+pieces, none of which touch the device path:
+
+* **Per-rank sidecars** — each rank periodically rewrites one JSON
+  file (``<trace base>.gang/rank_<r>.json``, schema
+  ``grape-gang-trace-v1``) holding its full event history, its
+  federated ``*_STATS`` snapshot, and the clock handshake.  The write
+  is a whole-file atomic replace per superstep boundary, so a rank
+  killed mid-run (``os._exit`` skips atexit; SIGKILL skips everything)
+  leaves its last completed snapshot behind — the crash-forensics
+  property the flight recorder has for breadcrumbs, extended to the
+  timeline.
+
+* **Clock handshake** — ``perf_counter`` is per-process (CLOCK_MONOTONIC
+  since an arbitrary epoch), so raw cross-rank timestamps are
+  incomparable.  ``ensure_handshake`` allgathers every rank's
+  monotonic + wall anchors at one collective instant (the int64
+  nanosecond values ride the existing int32 ``host_allgather`` as
+  30-bit words) and derives ``offset_ns[r] = anchor[0] - anchor[r]``;
+  the assembler shifts rank r's events by that offset so spans align
+  on rank 0's clock.  Residual skew is bounded by the allgather wall
+  time (recorded in the handshake), typically far under a superstep.
+
+* **Gang postmortem** — when a breach vote halts the gang, every rank
+  raises from the SAME vote cut (guard/vote.py), so every rank can
+  symmetrically dump its flight-recorder bundle under one shared
+  incident id (derived deterministically from the voted content — no
+  extra message carries it) and join one more allgather carrying a
+  28-bit sha prefix of the dumped bytes.  Rank 0 then writes the gang
+  manifest (``incident_<id>/gang.json``) verifying each shard's
+  digest against its rank's vote — the byte-verification discipline
+  recorder.py uses for single bundles, applied gang-wide.
+
+Symmetry contract: everything here that allgathers (the handshake, the
+postmortem sha-confirm) is gated on env/flag state that is identical
+across ranks (``GRAPE_TRACE`` / ``GRAPE_POSTMORTEM`` set gang-wide,
+same CLI flags), the same contract the breach vote itself relies on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from libgrape_lite_tpu.obs.federation import FederatedStats
+
+GANG_TRACE_SCHEMA = "grape-gang-trace-v1"
+GANG_BUNDLE_SCHEMA = "grape-gang-postmortem-v1"
+
+GANG_STATS = FederatedStats("gang", {
+    "handshakes": 0,
+    "sidecar_writes": 0,
+    "assemblies": 0,
+    "halts": 0,
+    "postmortems": 0,
+    "last_incident": None,
+})
+
+#: int64 nanoseconds ride the int32 allgather as little-endian 30-bit
+#: words (3 words = 90 bits, comfortably above wall-clock ns)
+_WORD_BITS = 30
+_WORD_MASK = (1 << _WORD_BITS) - 1
+_NS_WORDS = 3
+
+_state: Dict[str, Any] = {"handshake": None}
+
+
+def reset() -> None:
+    """Forget the cached handshake (tests re-handshake per case)."""
+    _state["handshake"] = None
+
+
+# ---- clock handshake -----------------------------------------------------
+
+
+def _split_ns(v: int) -> List[int]:
+    v = int(v)
+    return [(v >> (_WORD_BITS * i)) & _WORD_MASK
+            for i in range(_NS_WORDS)]
+
+
+def _join_ns(words) -> int:
+    return sum((int(w) & _WORD_MASK) << (_WORD_BITS * i)
+               for i, w in enumerate(words))
+
+
+def _default_allgather():
+    from libgrape_lite_tpu.parallel.comm_spec import host_allgather
+
+    return host_allgather
+
+
+def ensure_handshake(*, rank: Optional[int] = None,
+                     nprocs: Optional[int] = None,
+                     allgather=None,
+                     force: bool = False) -> Optional[dict]:
+    """Run (or return the cached) clock-offset handshake.
+
+    Every rank reads its monotonic + wall anchors immediately before
+    entering one collective allgather; the offsets that align each
+    rank onto rank 0's clock are identical on every rank (the
+    allgather is symmetric), so the assembler can run anywhere.
+    Returns None single-process (nothing to align)."""
+    if _state["handshake"] is not None and not force:
+        return _state["handshake"]
+    if rank is None or nprocs is None:
+        from libgrape_lite_tpu.obs.metrics import gang_identity
+
+        rank, nprocs = gang_identity()
+    if nprocs <= 1:
+        return None
+    if allgather is None:
+        allgather = _default_allgather()
+    t0 = time.perf_counter_ns()
+    vec = _split_ns(t0) + _split_ns(time.time_ns())
+    stacked = np.asarray(allgather(np.asarray(vec, np.int32)))
+    t1 = time.perf_counter_ns()
+    anchors = []
+    for r in range(stacked.shape[0]):
+        row = [int(x) for x in stacked[r]]
+        anchors.append({
+            "perf_ns": _join_ns(row[:_NS_WORDS]),
+            "wall_ns": _join_ns(row[_NS_WORDS:2 * _NS_WORDS]),
+        })
+    offsets = {
+        str(r): anchors[0]["perf_ns"] - a["perf_ns"]
+        for r, a in enumerate(anchors)
+    }
+    hs = {
+        "rank": int(rank),
+        "nprocs": int(stacked.shape[0]),
+        "anchors": anchors,
+        "offsets_ns": offsets,
+        "allgather_wall_ns": t1 - t0,  # skew upper bound
+    }
+    _state["handshake"] = hs
+    GANG_STATS["handshakes"] += 1
+    return hs
+
+
+# ---- per-rank sidecars ---------------------------------------------------
+
+
+def gang_dir(trace_path: Optional[str] = None) -> Optional[str]:
+    """`<trace base>.gang/` next to the configured Chrome trace, or
+    None when tracing has no file sink (in-memory arming)."""
+    if trace_path is None:
+        from libgrape_lite_tpu.obs import config
+
+        trace_path = config._state["trace_path"]
+    if not trace_path:
+        return None
+    base, ext = os.path.splitext(trace_path)
+    return (base if ext else trace_path) + ".gang"
+
+
+def sidecar_path(rank: int,
+                 trace_path: Optional[str] = None) -> Optional[str]:
+    d = gang_dir(trace_path)
+    return os.path.join(d, f"rank_{int(rank)}.json") if d else None
+
+
+def write_sidecar(*, tracer=None, path: Optional[str] = None,
+                  handshake: Optional[dict] = None,
+                  events: Optional[list] = None) -> Optional[str]:
+    """Atomically rewrite this rank's sidecar with its full event
+    history + federation snapshot.  Whole-file replace, so a rank
+    killed between writes leaves the previous complete snapshot — the
+    merge never sees a torn file.  Returns the path or None (disarmed
+    / no file sink).  Never raises."""
+    try:
+        from libgrape_lite_tpu import obs
+        from libgrape_lite_tpu.obs import federation
+
+        if tracer is None:
+            tracer = obs.tracer()
+        if not tracer.enabled:
+            return None
+        rank = tracer.pid
+        if path is None:
+            path = sidecar_path(rank)
+        if path is None:
+            return None
+        if handshake is None:
+            handshake = _state["handshake"]
+        if events is None:
+            events = (obs.history() if tracer is obs.tracer()
+                      else tracer.events())
+        try:
+            fed = federation.snapshot()
+        except Exception:
+            fed = {}
+        doc = {
+            "schema": GANG_TRACE_SCHEMA,
+            "rank": int(rank),
+            "nprocs": int(tracer.nprocs),
+            "trace_id": tracer.trace_id,
+            "wall_anchor": tracer.wall_anchor(),
+            "handshake": handshake,
+            "federation": fed,
+            "events": list(events),
+        }
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, default=str)
+            fh.write("\n")
+        os.replace(tmp, path)
+        GANG_STATS["sidecar_writes"] += 1
+        return path
+    except Exception:
+        return None
+
+
+# ---- rank-0 assembler ----------------------------------------------------
+
+_SIDE_RE = re.compile(r"^rank_(\d+)\.json$")
+
+
+def load_sidecars(dirpath: str) -> List[dict]:
+    """Every `rank_<r>.json` under `dirpath`, sorted by rank."""
+    docs = []
+    for fn in sorted(os.listdir(dirpath)):
+        m = _SIDE_RE.match(fn)
+        if not m:
+            continue
+        with open(os.path.join(dirpath, fn)) as fh:
+            doc = json.load(fh)
+        doc["rank"] = int(doc.get("rank", int(m.group(1))))
+        docs.append(doc)
+    docs.sort(key=lambda d: d["rank"])
+    return docs
+
+
+def assemble(dirpath: str,
+             out_path: Optional[str] = None) -> dict:
+    """Merge every rank sidecar under `dirpath` into one Perfetto
+    timeline (one process track per rank) and report completeness.
+
+    Clock alignment: each rank's non-metadata events are shifted by
+    the handshake's `offset_ns[rank]` so all timestamps land on rank
+    0's monotonic clock; the merged stream is then sorted, so
+    post-alignment timestamps are monotonic by construction and the
+    summary verifies it.  Flow-event legs (`ph` s/t/f) keep their
+    `(cat, id)` so Perfetto draws vote / 2PC arrows across the rank
+    tracks."""
+    docs = load_sidecars(dirpath)
+    if not docs:
+        return {"ranks": [], "nprocs": 0, "events": 0,
+                "complete": False, "monotonic": False, "aligned": False,
+                "missing": [], "flow_ids": 0, "flow_events": 0,
+                "spans_by_rank": {}, "supersteps_by_rank": {},
+                "out": None}
+    nprocs = max(int(d.get("nprocs", 1)) for d in docs)
+    offsets: Dict[int, int] = {}
+    for d in docs:
+        hs = d.get("handshake") or {}
+        for k, v in (hs.get("offsets_ns") or {}).items():
+            offsets.setdefault(int(k), int(v))
+    merged: List[dict] = []
+    aligned = True
+    for d in docs:
+        off = offsets.get(d["rank"])
+        if off is None:
+            off = 0
+            if nprocs > 1:
+                aligned = False
+        off_us = off / 1000.0
+        for ev in d.get("events", ()):
+            ev = dict(ev)
+            if ev.get("ph") != "M":
+                ev["ts"] = float(ev.get("ts", 0)) + off_us
+            merged.append(ev)
+    merged.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               float(e.get("ts", 0)),
+                               int(e.get("pid", 0))))
+    ranks = [d["rank"] for d in docs]
+    missing = [r for r in range(nprocs) if r not in ranks]
+    spans_by_rank = {
+        str(d["rank"]): sum(1 for e in d.get("events", ())
+                            if e.get("ph") == "X")
+        for d in docs
+    }
+    supersteps_by_rank = {
+        str(d["rank"]): sum(1 for e in d.get("events", ())
+                            if e.get("ph") == "X"
+                            and e.get("name") == "superstep")
+        for d in docs
+    }
+    flows: Dict[tuple, set] = {}
+    flow_events = 0
+    for ev in merged:
+        if ev.get("ph") in ("s", "t", "f"):
+            flow_events += 1
+            flows.setdefault(
+                (ev.get("cat"), ev.get("id")), set()
+            ).add(ev.get("pid"))
+    ts_seq = [float(e["ts"]) for e in merged if e.get("ph") != "M"]
+    monotonic = all(b >= a for a, b in zip(ts_seq, ts_seq[1:]))
+    complete = (not missing and aligned
+                and all(v > 0 for v in spans_by_rank.values()))
+    summary = {
+        "ranks": ranks,
+        "nprocs": nprocs,
+        "events": len(merged),
+        "spans_by_rank": spans_by_rank,
+        "supersteps_by_rank": supersteps_by_rank,
+        "flow_ids": len(flows),
+        "flow_events": flow_events,
+        "cross_rank_flows": sum(
+            1 for pids in flows.values() if len(pids) >= 2),
+        "missing": missing,
+        "aligned": aligned,
+        "monotonic": monotonic,
+        "complete": complete,
+        "out": None,
+    }
+    if out_path:
+        doc = {
+            "traceEvents": merged,
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "producer": "libgrape-lite-tpu obs/gang",
+                "gang": {
+                    "schema": GANG_TRACE_SCHEMA,
+                    "nprocs": nprocs,
+                    "ranks": ranks,
+                    "offsets_ns": {str(k): v
+                                   for k, v in sorted(offsets.items())},
+                    "trace_ids": {str(d["rank"]): d.get("trace_id")
+                                  for d in docs},
+                    "federation": {str(d["rank"]): d.get("federation")
+                                   for d in docs},
+                },
+            },
+        }
+        os.makedirs(os.path.dirname(os.path.abspath(out_path)),
+                    exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+            fh.write("\n")
+        os.replace(tmp, out_path)
+        summary["out"] = out_path
+    GANG_STATS["assemblies"] += 1
+    return summary
+
+
+# ---- distributed flight recorder -----------------------------------------
+
+
+def trace_word() -> int:
+    """28-bit prefix of this process's trace id (0 disarmed) —
+    int32-safe, so it can ride the vote / 2PC allgather vectors and
+    let the merged matrix name every rank's trace file."""
+    try:
+        from libgrape_lite_tpu import obs
+
+        tid = obs.trace_id()
+        return int(tid[:7], 16) if tid else 0
+    except Exception:
+        return 0
+
+
+def incident_id(basis) -> str:
+    """Deterministic 16-hex incident id over JSON-serializable basis
+    content.  guard/vote.py feeds the full allgathered vote matrix —
+    identical bytes on every rank — so the gang agrees on the id
+    without any extra message."""
+    raw = json.dumps(basis, sort_keys=True, default=str)
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
+def _sha_words_of_file(path: str) -> tuple:
+    """Two 28-bit words of the file's sha256 (int32-safe, the
+    ft/distributed.py `_sha_prefix` discipline)."""
+    with open(path, "rb") as fh:
+        h = hashlib.sha256(fh.read()).hexdigest()
+    return int(h[:7], 16), int(h[7:14], 16)
+
+
+def gang_postmortem(incident: str, reason: str, *,
+                    extra: Optional[dict] = None,
+                    rank: Optional[int] = None,
+                    nprocs: Optional[int] = None,
+                    allgather=None) -> Optional[dict]:
+    """Dump this rank's postmortem shard under the shared incident id
+    and (rank 0) assemble the byte-verified gang manifest.
+
+    Every rank must call this from the same logical cut (the breach
+    vote guarantees that) — the sha-confirm allgather is collective.
+    No sink configured -> counts only, no allgather (sink presence is
+    env-symmetric).  Never raises."""
+    try:
+        from libgrape_lite_tpu.obs.recorder import RECORDER
+
+        if rank is None or nprocs is None:
+            from libgrape_lite_tpu.obs.metrics import gang_identity
+
+            rank, nprocs = gang_identity()
+        GANG_STATS["postmortems"] += 1
+        GANG_STATS["last_incident"] = incident
+        sink = RECORDER.sink()
+        if not sink:
+            return None
+        shard = RECORDER.trigger(
+            reason, extra=extra, incident=incident,
+            filename=os.path.join(f"incident_{incident}",
+                                  f"rank_{int(rank)}.json"),
+        )
+        ok, lo, hi = 0, 0, 0
+        if shard:
+            try:
+                lo, hi = _sha_words_of_file(shard)
+                ok = 1
+            except Exception:
+                ok, lo, hi = 0, 0, 0
+        if nprocs > 1:
+            if allgather is None:
+                allgather = _default_allgather()
+            votes = np.asarray(
+                allgather(np.asarray([ok, lo, hi], np.int32)))
+        else:
+            votes = np.asarray([[ok, lo, hi]], np.int32)
+        out = {"incident": incident, "path": shard, "manifest": None,
+               "complete": None}
+        if int(rank) != 0:
+            return out
+        incident_dir = os.path.join(sink, f"incident_{incident}")
+        shards = {}
+        complete = True
+        for r in range(int(votes.shape[0])):
+            p = os.path.join(incident_dir, f"rank_{r}.json")
+            present = os.path.exists(p)
+            verified = False
+            if present and int(votes[r][0]) == 1:
+                try:
+                    verified = (_sha_words_of_file(p) ==
+                                (int(votes[r][1]), int(votes[r][2])))
+                except Exception:
+                    verified = False
+            complete = complete and present and verified
+            shards[str(r)] = {
+                "path": f"rank_{r}.json",
+                "present": present,
+                "verified": verified,
+                "sha28x2": [int(votes[r][1]), int(votes[r][2])],
+            }
+        manifest = {
+            "schema": GANG_BUNDLE_SCHEMA,
+            "incident": incident,
+            "reason": reason,
+            "nprocs": int(votes.shape[0]),
+            "complete": complete,
+            "shards": shards,
+        }
+        os.makedirs(incident_dir, exist_ok=True)
+        mpath = os.path.join(incident_dir, "gang.json")
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(manifest, fh, indent=1)
+            fh.write("\n")
+        os.replace(tmp, mpath)
+        out["manifest"] = mpath
+        out["complete"] = complete
+        return out
+    except Exception:
+        return None
+
+
+def on_breach_halt(err, rounds, *, allgather=None) -> None:
+    """Worker hook for a vote-raised halt: stamp the halt into the
+    timeline, drain a final sidecar, and run the gang postmortem under
+    the incident id the vote attached (`err.gang_incident`).  All
+    ranks raise from the same vote cut, so this runs symmetrically.
+    Never raises — forensics must not mask the halt."""
+    try:
+        incident = getattr(err, "gang_incident", None) or incident_id(
+            [type(err).__name__, int(rounds)])
+        GANG_STATS["halts"] += 1
+        GANG_STATS["last_incident"] = incident
+        from libgrape_lite_tpu import obs
+
+        tr = obs.tracer()
+        if tr.enabled:
+            tr.instant("gang_halt", round=int(rounds),
+                       error=type(err).__name__, incident=incident)
+            write_sidecar()
+        extra: Dict[str, Any] = {
+            "round": int(rounds), "error": type(err).__name__,
+        }
+        bundle = getattr(err, "bundle", None)
+        if isinstance(bundle, dict):
+            extra["vote"] = {
+                k: bundle[k] for k in ("rounds", "ranks", "codes")
+                if k in bundle
+            }
+        gang_postmortem(
+            incident, f"breach_halt_{type(err).__name__}",
+            extra=extra, allgather=allgather,
+        )
+    except Exception:
+        pass

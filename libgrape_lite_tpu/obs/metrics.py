@@ -121,6 +121,24 @@ class _NullInstrument:
 _NULL_INSTRUMENT = _NullInstrument()
 
 
+def gang_identity() -> tuple:
+    """(rank, nprocs) read live from jax.distributed, (0, 1) when the
+    process is not part of an initialized gang.  Shared by the metric
+    sinks and the gang sidecars so every exported row agrees on who
+    wrote it."""
+    try:
+        from jax._src import distributed
+
+        st = distributed.global_state
+        if getattr(st, "client", None) is None:
+            return 0, 1  # jax.distributed not initialized
+        rank = int(st.process_id or 0)
+        n = int(getattr(st, "num_processes", None) or 1)
+        return rank, n
+    except Exception:
+        return 0, 1
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()  # creation only; updates are GIL-atomic
@@ -218,13 +236,29 @@ class MetricsRegistry:
                 os.makedirs(
                     os.path.dirname(os.path.abspath(p)), exist_ok=True
                 )
+        rank, nprocs = gang_identity()
         if json_path:
+            snap = self.snapshot()
+            if nprocs > 1:
+                # stamp WHO wrote each row; single-process snapshots
+                # stay byte-identical to the pre-gang schema
+                for row in snap.values():
+                    row["rank"] = rank
+                    row["nprocs"] = nprocs
             with open(json_path, "w") as fh:
-                json.dump(self.snapshot(), fh, indent=1, sort_keys=True)
+                json.dump(snap, fh, indent=1, sort_keys=True)
                 fh.write("\n")
         if prom_path:
+            text = self.to_prometheus_text()
+            if nprocs > 1:
+                text += (
+                    "# TYPE grape_gang_rank gauge\n"
+                    f"grape_gang_rank {rank}\n"
+                    "# TYPE grape_gang_nprocs gauge\n"
+                    f"grape_gang_nprocs {nprocs}\n"
+                )
             with open(prom_path, "w") as fh:
-                fh.write(self.to_prometheus_text())
+                fh.write(text)
 
 
 def _fmt(v) -> str:

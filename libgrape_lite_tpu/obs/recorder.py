@@ -129,7 +129,7 @@ class FlightRecorder:
             fed = federation.snapshot()
         except Exception:
             fed = {}
-        return {
+        bundle = {
             "schema": BUNDLE_SCHEMA,
             "reason": reason,
             "trace_id": trace_id,
@@ -141,14 +141,34 @@ class FlightRecorder:
             "guard": guard,
             "extra": extra or {},
         }
+        try:
+            from libgrape_lite_tpu.obs.metrics import gang_identity
+
+            rank, nprocs = gang_identity()
+            if nprocs > 1:
+                # who dumped this shard; single-process manifests stay
+                # byte-identical to the pre-gang schema
+                bundle["rank"] = rank
+                bundle["nprocs"] = nprocs
+        except Exception:
+            pass
+        return bundle
 
     def trigger(self, reason: str,
                 extra: Optional[Dict[str, Any]] = None,
-                guard: Optional[Dict[str, Any]] = None
+                guard: Optional[Dict[str, Any]] = None,
+                incident: Optional[str] = None,
+                filename: Optional[str] = None,
                 ) -> Optional[str]:
         """Count the postmortem-worthy moment; dump a bundle when a
         sink is configured.  Returns the bundle path or None.  Never
-        raises."""
+        raises.
+
+        `incident` stamps a gang-shared incident id into the bundle;
+        `filename` overrides the default `postmortem_<reason>_<seq>`
+        name (relative to the sink — obs/gang.py uses
+        `incident_<id>/rank_<r>.json` so every rank's shard of one
+        incident lands in one directory)."""
         try:
             REC_STATS["triggers"] += 1
             REC_STATS["last_reason"] = reason
@@ -157,16 +177,22 @@ class FlightRecorder:
                 return None
             bundle = self.build_bundle(reason, extra=extra,
                                        guard=guard)
+            if incident:
+                bundle["incident"] = incident
             with self._lock:
                 self._seq += 1
                 seq = self._seq
             os.makedirs(sink, exist_ok=True)
-            safe = "".join(
-                c if c.isalnum() or c in "-_" else "_"
-                for c in reason
-            )
-            path = os.path.join(
-                sink, f"postmortem_{safe}_{seq:03d}.json")
+            if filename:
+                path = os.path.join(sink, filename)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+            else:
+                safe = "".join(
+                    c if c.isalnum() or c in "-_" else "_"
+                    for c in reason
+                )
+                path = os.path.join(
+                    sink, f"postmortem_{safe}_{seq:03d}.json")
             tmp = path + ".tmp"
             with open(tmp, "w") as fh:
                 json.dump(bundle, fh, indent=1, sort_keys=False,

@@ -29,7 +29,12 @@ between the dispatch returning and the sync: the span then reports
 `dispatched_us` (host enqueue) and `device_wait_us` (sync wait, the
 device-execution estimate) in its args.  The first round after a
 compile still includes trace+compile time in `dispatch_us`; spans
-never try to hide that — bench-style callers warm up first.
+never try to hide that — instead the worker calls
+`span.mark("compiled")` on any round whose runner came out of a jit
+cache MISS, so the span carries `compiled_us` and downstream readers
+(the overlap truth meter, trace_report) can EXCLUDE compile rounds
+from overlap accounting rather than silently folding compile time
+into the measurement.
 """
 
 from __future__ import annotations
@@ -44,6 +49,7 @@ from typing import Any, Dict, Optional
 from libgrape_lite_tpu.obs.events import (
     FRAG_TID_BASE,
     counter_event,
+    flow_event,
     instant_event,
     metadata_event,
     span_event,
@@ -134,9 +140,11 @@ class Tracer:
     instance — call sites hold no state, they re-read the global
     through `obs.tracer()` per query."""
 
-    def __init__(self, enabled: bool = True, *, rank: int | None = None):
+    def __init__(self, enabled: bool = True, *, rank: int | None = None,
+                 nprocs: int | None = None):
         self.enabled = enabled
         self._rank_fallback = int(rank or 0)
+        self._nprocs_fallback = int(nprocs or 1)
         self.trace_id = uuid.uuid4().hex if enabled else None
         self._buf = deque()  # lock-free: deque.append is GIL-atomic
         self._meta_rows: list = []  # (tid, name) thread rows
@@ -159,10 +167,33 @@ class Tracer:
         try:
             from jax._src import distributed
 
-            pid = distributed.global_state.process_id
+            st = distributed.global_state
+            if getattr(st, "client", None) is None:
+                # jax.distributed not initialized: the pre-init
+                # process_id default (0) is indistinguishable from a
+                # real rank, so the constructor fallback wins — tests
+                # build fake rank-r tracers this way
+                return self._rank_fallback
+            pid = st.process_id
             return int(pid) if pid is not None else self._rank_fallback
         except Exception:
             return self._rank_fallback
+
+    @property
+    def nprocs(self) -> int:
+        """Gang size, read live like `pid` (same pre-init caveat); the
+        constructor fallback lets tests build a fake rank-r-of-n tracer
+        without touching jax.distributed."""
+        try:
+            from jax._src import distributed
+
+            st = distributed.global_state
+            if getattr(st, "client", None) is None:
+                return self._nprocs_fallback
+            n = getattr(st, "num_processes", None)
+            return int(n) if n else self._nprocs_fallback
+        except Exception:
+            return self._nprocs_fallback
 
     # ---- track bookkeeping ----------------------------------------------
 
@@ -221,13 +252,24 @@ class Tracer:
 
     # ---- emitters --------------------------------------------------------
 
+    def _push(self, ev: Dict[str, Any]) -> None:
+        """Buffer one event, stamping `rank`/`nprocs` when the process
+        is part of a real gang.  Single-process exports (nprocs == 1)
+        are untouched so rank-0 solo output stays byte-identical to
+        the pre-gang schema."""
+        n = self.nprocs
+        if n > 1:
+            ev["rank"] = ev["pid"]
+            ev["nprocs"] = n
+        self._buf.append(ev)
+
     def span(self, name: str, **args):
         if not self.enabled:
             return NULL_SPAN
         return Span(self, name, self._tid(), args)
 
     def _emit_span(self, span: Span) -> None:
-        self._buf.append(span_event(
+        self._push(span_event(
             span.name, ts_ns=span.t0_ns, dur_ns=span.dur_ns,
             pid=self.pid, tid=span.tid,
             args=span.args or None,
@@ -241,7 +283,7 @@ class Tracer:
         fragment's interval)."""
         if not self.enabled:
             return
-        self._buf.append(span_event(
+        self._push(span_event(
             name, ts_ns=t0_ns, dur_ns=dur_ns, pid=self.pid, tid=tid,
             args=args or None,
         ))
@@ -249,7 +291,7 @@ class Tracer:
     def instant(self, name: str, **args) -> None:
         if not self.enabled:
             return
-        self._buf.append(instant_event(
+        self._push(instant_event(
             name, ts_ns=time.perf_counter_ns(), pid=self.pid,
             tid=self._tid(), args=args or None,
         ))
@@ -257,9 +299,24 @@ class Tracer:
     def counter(self, name: str, **values) -> None:
         if not self.enabled:
             return
-        self._buf.append(counter_event(
+        self._push(counter_event(
             name, ts_ns=time.perf_counter_ns(), pid=self.pid,
             tid=self._tid(), values=values,
+        ))
+
+    def flow(self, name: str, *, flow_id: int, phase: str,
+             cat: str = "gang", **args) -> None:
+        """Emit one leg of a cross-rank flow arrow (ph s/t/f).  Every
+        rank participating in one logical edge (a breach vote, a 2PC
+        stage→commit) emits its own leg with the SAME `(cat, flow_id)`;
+        the gang assembler merges them and Perfetto draws the arrow
+        across process tracks."""
+        if not self.enabled:
+            return
+        self._push(flow_event(
+            name, ts_ns=time.perf_counter_ns(), pid=self.pid,
+            tid=self._tid(), flow_id=flow_id, phase=phase, cat=cat,
+            args=args or None,
         ))
 
     # ---- draining --------------------------------------------------------
@@ -292,6 +349,11 @@ class Tracer:
             metadata_event("thread_name", pid=pid, tid=tid, name=name)
             for tid, name in list(self._meta_rows)
         ]
+        n = self.nprocs
+        if n > 1:
+            for ev in rows:
+                ev["rank"] = pid
+                ev["nprocs"] = n
         return rows
 
     def wall_anchor(self) -> Dict[str, float]:

@@ -1,0 +1,188 @@
+"""Overlap truth meter: modeled `hidden_us_per_round` vs measured
+`device_wait_us`, joined per plan uid.
+
+Every pipeline/2-D engagement headline in this tree is *modeled*: the
+overlap model prices boundary/interior edges and exchange bytes under
+a rate profile and claims `hidden_us_per_round` of exchange time
+hidden under interior compute.  The tracer, meanwhile, *measures*: a
+span that `mark("dispatched")`s before syncing reports
+`device_wait_us`, the honest device-execution estimate.  This module
+reconciles the two — per plan uid, the correlation key grape-lint R12
+requires every modeled claim to carry — and reports how large the
+modeled claim is relative to the measured round wall
+(``claim_frac = modeled_hidden_us_per_round / measured_round_us``).
+
+A claim_frac above the limit (default 1.25) means the model claims to
+hide more exchange per round than the whole measured round took —
+physically impossible, so either the rate profile or the edge totals
+are wrong.  The bench ``calibration`` lane gates exit-2 on exactly
+that, but ONLY under an explicit ``GRAPE_RATE_PROFILE`` (the same
+condition as its rate-drift gate): on the CPU-fallback bench host,
+measured walls dwarf modeled TPU numbers, so the gate would never
+fire and the report is informational.
+
+Honesty rule: rounds whose span carries `compiled_us` (the worker
+marks the first dispatch of a fresh-compiled runner) are EXCLUDED —
+compile time in the denominator would launder the claim.
+
+Joined rows feed the calibration harvest
+(``ops.calibration.harvest_overlap``, armed by
+``GRAPE_CALIBRATE_HARVEST``) so fitted rate profiles can see measured
+overlap walls next to the spmv/spgemm surfaces.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+#: modeled hidden µs may not exceed the measured round wall by more
+#: than this factor (a little slack for clock/model noise)
+DEFAULT_CLAIM_LIMIT = 1.25
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def truth_report(events,
+                 claim_limit: float = DEFAULT_CLAIM_LIMIT) -> dict:
+    """Join every engaged pipelined query span in `events` against its
+    measured device waits.
+
+    Fused queries measure from the query span's own
+    `device_wait_us` / `rounds`; stepwise queries join the superstep
+    spans inside the query window (same pid) and take the median
+    `device_wait_us`.  Spans carrying `compiled_us` are excluded (and
+    counted) — see the module docstring."""
+    evs = [e for e in events if isinstance(e, dict)]
+    queries = [e for e in evs
+               if e.get("ph") == "X" and e.get("name") == "query"]
+    supersteps = [e for e in evs
+                  if e.get("ph") == "X" and e.get("name") == "superstep"]
+    rows: List[dict] = []
+    excluded_compile = 0
+    for q in queries:
+        a = q.get("args") or {}
+        pipe = a.get("pipeline") or {}
+        if not pipe.get("engaged"):
+            continue
+        modeled = float(pipe.get("hidden_us_per_round") or 0.0)
+        rounds = int(a.get("rounds") or 0)
+        measured: Optional[float] = None
+        n_meas = 0
+        if "compiled_us" in a:
+            # the whole fused dispatch included trace+compile: no
+            # honest device split exists for this query
+            excluded_compile += 1
+        elif "device_wait_us" in a:
+            # fused: one dispatch covers PEval + `rounds` IncEvals
+            measured = float(a["device_wait_us"]) / max(rounds + 1, 1)
+            n_meas = rounds + 1
+        else:
+            # stepwise: the per-round superstep spans inside the
+            # query window carry the splits
+            t0 = float(q.get("ts", 0))
+            t1 = t0 + float(q.get("dur", 0))
+            waits = []
+            for s in supersteps:
+                if s.get("pid") != q.get("pid"):
+                    continue
+                sa = s.get("args") or {}
+                if "device_wait_us" not in sa:
+                    continue
+                ts = float(s.get("ts", 0))
+                if not (t0 <= ts <= t1):
+                    continue
+                if "compiled_us" in sa:
+                    excluded_compile += 1
+                    continue
+                waits.append(float(sa["device_wait_us"]))
+            if waits:
+                measured = _median(waits)
+                n_meas = len(waits)
+        row: Dict[str, object] = {
+            "plan_uid": pipe.get("plan_uid") or "-",
+            "mode": pipe.get("mode"),
+            "modeled_hidden_us_per_round": modeled,
+            "measured_round_us": measured,
+            "rounds_measured": n_meas,
+            "joined": measured is not None,
+        }
+        if measured is not None and measured > 0:
+            frac = round(modeled / measured, 4)
+            row["claim_frac"] = frac
+            row["ok"] = frac <= claim_limit
+        else:
+            row["claim_frac"] = None
+            row["ok"] = None
+        rows.append(row)
+    joined = [r for r in rows if r["joined"]]
+    fracs = [r["claim_frac"] for r in joined
+             if r["claim_frac"] is not None]
+    return {
+        "queries": len(rows),
+        "joined": len(joined),
+        "compile_rounds_excluded": excluded_compile,
+        "claim_limit": claim_limit,
+        "max_claim_frac": max(fracs) if fracs else None,
+        "median_claim_frac": _median(fracs) if fracs else None,
+        "ok": (all(bool(r["ok"]) for r in joined
+                   if r["ok"] is not None)
+               if joined else True),
+        "rows": rows,
+    }
+
+
+def block_brief(report: dict) -> dict:
+    """The bench-block form of a truth report: schema-stable scalars
+    for the first joined row (check_bench_schema pins the keys)."""
+    first = next((r for r in report["rows"] if r["joined"]), None) or {}
+    return {
+        "queries": int(report["queries"]),
+        "joined": int(report["joined"]),
+        "plan_uid": str(first.get("plan_uid") or "-"),
+        "modeled_hidden_us_per_round": float(
+            first.get("modeled_hidden_us_per_round") or 0.0),
+        "measured_round_us": float(
+            first.get("measured_round_us") or 0.0),
+        "claim_frac": float(first.get("claim_frac") or 0.0),
+        "compile_rounds_excluded": int(
+            report["compile_rounds_excluded"]),
+        "ok": bool(report["ok"]),
+    }
+
+
+def harvest_report(events_or_report, pipe_brief: Optional[dict] = None,
+                   ) -> int:
+    """Feed every joined reconciliation row into the calibration
+    harvest buffer (no-op unless ``GRAPE_CALIBRATE_HARVEST`` is
+    armed).  Accepts either a raw event list or an already-built
+    truth report; `pipe_brief` supplies the edge/byte columns when
+    the caller has the live plan brief (bench lanes do) — without it
+    the row still lands with the span's modeled/measured pair but
+    zero op columns, so it is skipped.  Returns rows harvested."""
+    from libgrape_lite_tpu.ops import calibration as calib
+
+    if not calib.harvest_armed():
+        return 0
+    report = (events_or_report
+              if isinstance(events_or_report, dict)
+              else truth_report(events_or_report))
+    n = 0
+    for row in report["rows"]:
+        if not row["joined"]:
+            continue
+        brief = dict(pipe_brief or {})
+        brief.setdefault("plan_uid", row["plan_uid"])
+        brief.setdefault("hidden_us_per_round",
+                         row["modeled_hidden_us_per_round"])
+        sample = calib.harvest_overlap(
+            brief, float(row["measured_round_us"]),
+            max(int(row["rounds_measured"]), 1),
+        )
+        if sample is not None:
+            n += 1
+    return n

@@ -864,6 +864,41 @@ def harvest_dispatch(stages: Optional[dict], totals: Optional[dict],
     return sample
 
 
+def harvest_overlap(plan_brief: Optional[dict],
+                    measured_round_us: float,
+                    rounds: int) -> Optional[dict]:
+    """Overlap-truth reconciliation row: the truth meter's measured
+    per-round device wall joined against the pipeline brief's edge /
+    exchange-byte columns (obs/truth.py is the producer).  The row
+    rides the same harvest buffer `fit_rates` consumes — surface
+    ``overlap`` — and additionally carries the plan uid and the
+    modeled per-round hidden µs so a later fit (or a human) can see
+    exactly which modeled claim the wall was reconciled against."""
+    if not plan_brief or rounds <= 0:
+        return None
+    if not measured_round_us or measured_round_us <= 0:
+        return None
+    edges = (int(plan_brief.get("boundary_edges", 0))
+             + int(plan_brief.get("interior_edges", 0)))
+    sample = {
+        "surface": "overlap",
+        "plan_uid": plan_brief.get("plan_uid") or "-",
+        "wall_s": measured_round_us * rounds / 1e6,
+        "vpu_ops": edges * rounds,
+        "mxu_ops": 0,
+        "gather_rows": 0,
+        "hbm_bytes": int(plan_brief.get("exchange_bytes", 0)) * rounds,
+        "modeled_hidden_us_per_round": float(
+            plan_brief.get("hidden_us_per_round") or 0.0),
+    }
+    if sample["vpu_ops"] == 0 and sample["hbm_bytes"] == 0:
+        return None
+    _HARVEST.append(sample)
+    if len(_HARVEST) > _HARVEST_MAX:
+        del _HARVEST[: _HARVEST_MAX // 2]
+    return sample
+
+
 def harvest_from_worker(worker, stages: Optional[dict],
                         rounds: int) -> Optional[dict]:
     """The serve-session hook: pull the dispatching worker's merged

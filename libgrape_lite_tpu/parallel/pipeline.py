@@ -317,6 +317,7 @@ class PipelinePlan:
         return {
             "engaged": True,
             "mode": self.mode,
+            "plan_uid": self.uid,
             "exchange_bytes": self.exchange_bytes,
             "modeled_hidden_frac": model["hidden_frac"],
             "hidden_us_per_round": self.hidden_us_per_round(),
@@ -513,6 +514,14 @@ def resolve_pipeline(frag, *, app_name: str, key: str,
         hidden_us = min(model["compute_interior_s"],
                         model["exchange_s"]) * 1e6
         decision["modeled_hidden_us"] = round(hidden_us, 3)
+        # grape-lint R12: a modeled claim must carry its trace
+        # correlation key even on the declined path (same recipe as
+        # PipelinePlan.uid; re-stamped authoritatively on engage)
+        decision["plan_uid"] = (
+            f"{xmode}:{frag.fnum}:{frag.vp}:"
+            f"{mirror.m if mirror is not None else 0}:"
+            f"{'pack' if pack is not None else 'xla'}:{xmode2 or '-'}"
+        )
         if hidden_us < min_hidden:
             return declined(
                 f"modeled hidden exchange {hidden_us:.2f}us under "
@@ -568,6 +577,7 @@ def resolve_pipeline(frag, *, app_name: str, key: str,
         m2=mirror2.m if mirror2 is not None else 0,
         send_key2=mx2_prefix + "send",
     )
+    decision["plan_uid"] = plan.uid  # the truth meter's join key
     PIPELINE_STATS["resolved"] += 1
     PIPELINE_STATS["last_decision"] = decision
     PIPELINE_STATS["last_stats"] = stats
@@ -636,6 +646,7 @@ class VC2DPipelinePlan:
         return {
             "engaged": True,
             "mode": self.mode,
+            "plan_uid": self.uid,
             "exchange_bytes": self.exchange_bytes,
             "modeled_hidden_frac": model["hidden_frac"],
             "hidden_us_per_round": self.hidden_us_per_round(),
@@ -741,6 +752,9 @@ def resolve_vc2d_pipeline(frag, *, app_name: str, pack=None,
     hidden_us = min(model["compute_interior_s"],
                     model["exchange_s"]) * 1e6
     decision["modeled_hidden_us"] = round(hidden_us, 3)
+    # grape-lint R12: the modeled claim carries its trace key even
+    # when a later gate declines (same recipe as VC2DPipelinePlan.uid)
+    decision["plan_uid"] = f"vc2d:{k}:{vc}:{split}"
 
     if mode == "auto" and xbytes < pipeline_min_bytes():
         return declined(

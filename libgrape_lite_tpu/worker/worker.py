@@ -632,6 +632,11 @@ class Worker:
         (serve/ asserts zero-recompile reuse through these counters)."""
         hit = key in self._runner_cache
         self.runner_cache_stats["hits" if hit else "misses"] += 1
+        # the overlap truth meter (obs/truth.py) must EXCLUDE rounds
+        # whose dispatch included trace+compile: the span sites read
+        # this flag right after the first dispatch of a fresh runner
+        # and stamp `mark("compiled")`
+        self._last_runner_miss = not hit
         if not hit:
             self._runner_cache[key] = build()
         return self._runner_cache[key]
@@ -1265,6 +1270,11 @@ class Worker:
                     frag.dev, carry, eph_part
                 )
                 t_enq = _time.perf_counter_ns()
+                if getattr(self, "_last_runner_miss", False):
+                    # fresh compile rode inside this enqueue: stamp it
+                    # so truth.py excludes the query from the measured
+                    # round wall (compile would launder the claim)
+                    sp.mark("compiled")
                 sp.mark("dispatched")
                 out_state = jax.block_until_ready(out_state)
                 self.rounds = int(rounds)
@@ -1415,14 +1425,19 @@ class Worker:
         # max-tile-skew column from exactly this record
         part = getattr(self.app, "_partition_stats", None)
         if part is not None:
-            sp.set(partition={
+            record = {
                 "mode": getattr(self.app, "_partition", "2d"),
                 "k": part["k"],
                 "max_tile_edges": part["max_tile_edges"],
                 "mean_tile_edges": part["mean_tile_edges"],
                 "tile_skew": part["tile_skew"],
                 "per_tile": part["per_tile"],
-            })
+            }
+            if "plan_uid" in part:
+                # the R12 correlation key: the truth meter joins this
+                # record against the modeled pipeline decision
+                record["plan_uid"] = part["plan_uid"]
+            sp.set(partition=record)
         # guard probe/breach/rollback counts live in the counters the
         # monitor itself maintains at the event sites — no duplicate
         # gauges here that could disagree after an aborted query
@@ -1787,6 +1802,11 @@ class Worker:
         try:
             with tr.span("query", mode="stepwise",
                          app=type(self.app).__name__) as sp:
+                if self._pipelined() is not None:
+                    # same record the fused path emits: the overlap
+                    # truth meter joins the superstep spans inside
+                    # this query window against this modeled brief
+                    sp.set(pipeline=self._pipelined().span_brief())
                 out = self._query_stepwise_impl(
                     max_rounds, checkpoint_every=checkpoint_every,
                     checkpoint_dir=checkpoint_dir, fault_plan=fault_plan,
@@ -1975,6 +1995,10 @@ class Worker:
                     p.get("mode", "?"), p.get("exchange_bytes", 0),
                 )
         inc_fn = self._single_step_for("inceval", state)
+        # a fresh-compiled inc_fn means the FIRST superstep dispatch
+        # below includes trace+compile: that round's span gets a
+        # `compiled` mark so the overlap truth meter can exclude it
+        inc_fresh = getattr(self, "_last_runner_miss", False)
         # ephemeral leaves drop out of each step's outputs; re-merge the
         # placed originals so the next step's inputs stay complete
         eph_vals = {k: state[k] for k in eph}
@@ -2020,21 +2044,42 @@ class Worker:
 
             vote = BreachVote.for_current_process()
 
+        # gang trace federation (obs/gang.py): anchor the clock
+        # handshake and land the first per-rank sidecar BEFORE the
+        # first vote collective, so even a round-0 halt leaves a
+        # mergeable file for the rank-0 assembler.  Symmetric by the
+        # same contract as the vote itself: GRAPE_TRACE is documented
+        # env-symmetric across the gang.
+        gang_armed = vote is not None and tr.enabled
+        if gang_armed:
+            obs.gang.ensure_handshake()
+            obs.gang.write_sidecar()
+
         def voted_hooks(vote_rounds, hooks):
             """Run one superstep boundary's host-side hazard hooks
             (probe / snapshot / fault injection) under the breach
             vote: every rank exchanges a verdict at this same cut, so
             a one-rank halt (InvariantBreachError, DivergenceError,
             InjectedFault, an IO error in a hook) halts EVERY rank
-            instead of stranding siblings in the next collective."""
+            instead of stranding siblings in the next collective.  A
+            halt raised by the vote (local err re-raise or
+            RemoteBreachError) first triggers the distributed flight
+            recorder: every rank dumps its postmortem shard under the
+            shared incident id the vote derived (obs/gang.py)."""
             if vote is None:
                 return hooks()
+            err = None
+            out = None
             try:
                 out = hooks()
-            except Exception as err:
-                vote.round_vote(vote_rounds, err)  # always re-raises
-                raise  # pragma: no cover - round_vote raised already
-            vote.round_vote(vote_rounds)
+            except Exception as e:
+                err = e
+            try:
+                vote.round_vote(vote_rounds, err)  # re-raises err
+            except BaseException as halt:
+                if gang_armed:
+                    obs.gang.on_breach_halt(halt, vote_rounds)
+                raise
             return out
 
         # the monotone invariants compare against the carry of the LAST
@@ -2061,6 +2106,9 @@ class Worker:
             # dispatch-only time a naive t1-t0 around the call measures
             with tr.span("peval", round=0) as sp:
                 out = peval_fn(frag.dev, state)
+                if getattr(self, "_last_runner_miss", False):
+                    # truth.py excludes compile-bearing rounds
+                    sp.mark("compiled")
                 sp.mark("dispatched")
                 state, active = jax.block_until_ready(out)
                 sp.set(active=int(active))
@@ -2106,6 +2154,10 @@ class Worker:
                     fault_plan.on_superstep(0, ckpt)
 
             voted_hooks(0, peval_hooks)
+            if gang_armed:
+                # drain this rank's spans so the merged gang timeline
+                # survives a kill at any later round
+                obs.gang.write_sidecar()
             if monitor is not None and int(active) >= 0 and monitor.due(0):
                 guard_prev = carry_of(state)
 
@@ -2141,6 +2193,8 @@ class Worker:
             if changed:
                 # the rebuilt state carries fresh ephemeral leaves
                 eph_vals = {k: state[k] for k in eph}
+                inc_fresh = (inc_fresh
+                             or getattr(self, "_last_runner_miss", False))
                 if monitor is not None:
                     monitor.on_mutation(frag, self.pack_ledger())
                     guard_prev = carry_of(state)
@@ -2153,6 +2207,11 @@ class Worker:
                 # span (and the vlog line) cover dispatch + device wait
                 with tr.span("superstep", round=rounds + 1) as sp:
                     out = inc_fn(frag.dev, state)
+                    if inc_fresh:
+                        # first dispatch since (re)compile: truth.py
+                        # excludes this round's wait from the join
+                        sp.mark("compiled")
+                        inc_fresh = False
                     sp.mark("dispatched")
                     state, active = jax.block_until_ready(out)
                     sp.set(active=int(active))
@@ -2219,6 +2278,8 @@ class Worker:
                     return None
 
                 rolled = voted_hooks(rounds, round_hooks)
+                if gang_armed:
+                    obs.gang.write_sidecar()
                 if rolled is not None:
                     restored, meta = rolled
                     state = {**state, **self._place_state(restored)}
@@ -2239,6 +2300,10 @@ class Worker:
                     )
                     if changed:
                         eph_vals = {k: state[k] for k in eph}
+                        inc_fresh = (
+                            inc_fresh
+                            or getattr(self, "_last_runner_miss", False)
+                        )
                         if monitor is not None:
                             # the graph (and its superstep operator)
                             # changed: digest history no longer proves

@@ -153,6 +153,9 @@ _PIPELINE = {
     "app": (str, True),
     "engaged": (bool, True),
     "mode": (str, True),
+    # the truth meter's join key (grape-lint R12): every modeled
+    # claim in this block is auditable only through this uid
+    "plan_uid": (str, True),
     "serial_s": (_NUM, True),
     "pipelined_s": (_NUM, True),
     "byte_identical": (bool, True),
@@ -163,6 +166,24 @@ _PIPELINE = {
     "boundary_edges": (int, True),
     "interior_edges": (int, True),
     "overlap_recount_mismatch": (_NUM, True),
+    "overlap_truth": (dict, True),
+}
+
+# the PR 20 modeled-vs-measured reconciliation (obs/truth.py
+# block_brief): the pipeline lane's modeled hidden_us_per_round joined
+# against the tracer's measured device waits per plan uid; rides the
+# `pipeline` block (the lane's own run) and the `calibration` block
+# (the main bench's history).  `claim_frac` above the claim limit
+# fails the bench under an explicit GRAPE_RATE_PROFILE.
+_OVERLAP_TRUTH = {
+    "queries": (int, True),
+    "joined": (int, True),
+    "plan_uid": (str, True),
+    "modeled_hidden_us_per_round": (_NUM, True),
+    "measured_round_us": (_NUM, True),
+    "claim_frac": (_NUM, True),
+    "compile_rounds_excluded": (int, True),
+    "ok": (bool, True),
 }
 
 # the r10 2-D vertex-cut partition lane (fragment/partition.py,
@@ -222,6 +243,7 @@ _VC2D_PIPELINE = {
     "pipelined_eq_serial_2d": (bool, True),
     "pipelined_eq_1d": (bool, True),
     "profile": (str, True),
+    "plan_uid": (str, True),
     "modeled_hidden_us": (_NUM, True),
     "modeled_hidden_frac": (_NUM, True),
     "measured_speedup": (_NUM, True),
@@ -379,6 +401,7 @@ _CALIBRATION = {
     "unfitted": (list, False),
     "fallback_notes": (list, False),
     "surfaces": (dict, False),
+    "overlap_truth": (dict, True),
 }
 
 _CALIB_SURFACE = {
@@ -403,6 +426,30 @@ _FT_DRILL = {
     "checkpoint_rounds": (int, True),
     "restore_wall_s": (_NUM, True),
     "byte_identical": (bool, True),
+    # the PR 20 gang-telemetry leg (tracer armed across the kill):
+    # merged-trace completeness, the vote's cross-rank flow count,
+    # and the byte-verified gang postmortem under one incident id
+    "gang_trace_events": (int, False),
+    "gang_trace_complete": (bool, False),
+    "gang_cross_rank_flows": (int, False),
+    "gang_incident": (str, False),
+    "gang_bundle_verified": (bool, False),
+}
+
+# the PR 20 bench gang-telemetry self-drill (bench.py obs_gang_lane):
+# two in-process fake-rank tracers federate sidecars through the real
+# assembler (completeness / alignment / monotonicity / cross-rank
+# flow verdicts), plus the armed-vs-disarmed fused-HLO byte-identity
+# re-proof.  Verdict fields are DECLARED bool.
+_OBS_GANG = {
+    "ranks": (int, True),
+    "events": (int, True),
+    "flow_events": (int, True),
+    "cross_rank_flows": (int, True),
+    "aligned": (bool, True),
+    "monotonic": (bool, True),
+    "complete": (bool, True),
+    "hlo_identical": (bool, True),
 }
 
 #: every nested block bench.py may emit — THE single declaration
@@ -425,6 +472,7 @@ _BLOCKS = {
     "autopilot": _AUTOPILOT,
     "calibration": _CALIBRATION,
     "ft_drill": _FT_DRILL,
+    "obs_gang": _OBS_GANG,
 }
 
 _TOP = {**_TOP_SCALARS, **{k: (dict, False) for k in _BLOCKS}}
@@ -620,6 +668,12 @@ def validate_record(record) -> list:
                 errors.append(f"{where}: expected object")
                 continue
             _check_block(point, _STAGE_POINT, where, errors)
+    for holder in ("pipeline", "calibration"):
+        blk = record.get(holder)
+        if isinstance(blk, dict) and isinstance(
+                blk.get("overlap_truth"), dict):
+            _check_block(blk["overlap_truth"], _OVERLAP_TRUTH,
+                         f"{holder}.overlap_truth", errors)
     cb = record.get("calibration")
     if isinstance(cb, dict):
         rates = cb.get("rates")

@@ -59,11 +59,19 @@ resilience drill (docs/FAULT_TOLERANCE.md, "Distributed resilience"):
      (`ckpt_<K>/rank_<r>.npz`); `GRAPE_FT_FAULTS=kill_rank@K:1` kills
      rank 1 right after superstep K's commit is durable, stranding
      rank 0 in the next collective (genuine process loss).
-  3. **reshard restore** — a single survivor process resumes the
+  3. **gang telemetry** (PR 20) — the same gang re-runs with
+     GRAPE_TRACE + GRAPE_POSTMORTEM armed and a RAISE-mode kill: the
+     injected fault travels the breach vote, both ranks halt, the
+     per-rank sidecars merge into one Perfetto timeline (both ranks'
+     superstep spans, a vote flow crossing the rank tracks, monotonic
+     aligned timestamps), and every rank's postmortem shard lands
+     under one `incident_<id>/` with a byte-verified `gang.json`.
+  4. **reshard restore** — a single survivor process resumes the
      4-shard snapshot onto fnum 2 (`restore_resharded`).
-  4. **verify** — the resumed output must be byte-identical to the
+  5. **verify** — the resumed output must be byte-identical to the
      fault-free run; a schema'd `ft_drill` JSON record is emitted
-     (scripts/check_bench_schema.py).  Exit 2 iff results diverge.
+     (scripts/check_bench_schema.py) carrying the gang-telemetry
+     fields.  Exit 2 iff results diverge.
 
 Exit code 0 iff every app passes.  Usage:
 
@@ -345,6 +353,145 @@ def postmortem_drill(args, workdir: str) -> bool:
     return True
 
 
+def _gang_telemetry_leg(app: str, args, wd: str, common) -> dict | None:
+    """Gang-wide telemetry leg of the kill_rank drill (PR 20,
+    docs/OBSERVABILITY.md "Gang-wide telemetry"): re-run the
+    2-process gang with the tracer armed and a RAISE-mode rank kill,
+    so the injected fault travels the breach vote instead of
+    os._exit — rank 1 re-raises InjectedFault, rank 0 halts on
+    RemoteBreachError, and BOTH ranks land their telemetry:
+
+      * per-rank trace sidecars under `<trace>.gang/`, merged here via
+        obs.gang.assemble — the drill pins both ranks' superstep
+        spans, at least one breach-vote flow crossing the rank tracks,
+        and monotonic post-alignment timestamps;
+      * the distributed flight recorder: one `incident_<id>/` dir in
+        the GRAPE_POSTMORTEM sink holding every rank's shard plus the
+        rank-0 `gang.json` manifest with byte-verified shard hashes.
+
+    Returns the gang fields for the ft_drill record, or None on any
+    failed check."""
+    import glob
+    import json
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    trace = os.path.join(wd, "gang_trace.json")
+    pm = os.path.join(wd, "gang_pm")
+    env = dict(os.environ)
+    env.pop("GRAPE_GUARD", None)
+    # the whole point of mode=raise: the kill is an exception, so the
+    # breach vote (not a stranded collective) halts the gang and the
+    # telemetry plane gets to run on every rank
+    env["GRAPE_FT_FAULTS"] = f"kill_rank@{args.kill_at}:1,mode=raise"
+    env["GRAPE_TRACE"] = trace
+    env["GRAPE_POSTMORTEM"] = pm
+    flags = common + [
+        "--fnum", "4",
+        "--checkpoint_dir", os.path.join(wd, "ck_gangtrace"),
+        "--out_prefix", os.path.join(wd, "out_gangtrace"),
+        "--coordinator", coord, "--num_processes", "2",
+    ]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "libgrape_lite_tpu.cli"]
+            + flags + ["--process_id", str(r)],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    timed_out = False
+    for q in procs:
+        try:
+            out, _ = q.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            q.kill()
+            out, _ = q.communicate()
+        outs.append(out.decode(errors="replace"))
+    if timed_out or any(q.returncode == 0 for q in procs):
+        print(
+            f"[{app}] FAIL: raise-mode gang must halt BOTH ranks "
+            f"through the vote (rcs="
+            f"{[q.returncode for q in procs]}, timeout={timed_out})\n"
+            f"--- rank 0 ---\n{outs[0]}\n--- rank 1 ---\n{outs[1]}"
+        )
+        return None
+
+    from libgrape_lite_tpu.obs import gang
+
+    summary = gang.assemble(
+        os.path.splitext(trace)[0] + ".gang",
+        out_path=os.path.join(wd, "gang_merged.json"),
+    )
+    problems = []
+    if not summary["complete"]:
+        problems.append(
+            f"merged gang trace incomplete: ranks={summary['ranks']} "
+            f"missing={summary['missing']} aligned={summary['aligned']}"
+        )
+    if any(int(summary["supersteps_by_rank"].get(str(r), 0)) < 1
+           for r in range(2)):
+        problems.append(
+            "a rank contributed no superstep spans: "
+            f"{summary['supersteps_by_rank']}"
+        )
+    if summary["cross_rank_flows"] < 1:
+        problems.append(
+            f"no breach-vote flow crosses the rank tracks "
+            f"({summary['flow_events']} flow leg(s), "
+            f"{summary['flow_ids']} id(s))"
+        )
+    if not summary["monotonic"]:
+        problems.append("post-alignment timestamps are not monotonic")
+
+    incident_dirs = sorted(glob.glob(os.path.join(pm, "incident_*")))
+    manifest = {}
+    if len(incident_dirs) != 1:
+        problems.append(
+            f"expected ONE shared incident dir, found "
+            f"{[os.path.basename(d) for d in incident_dirs]}"
+        )
+    else:
+        inc = incident_dirs[0]
+        for r in range(2):
+            if not os.path.exists(os.path.join(inc, f"rank_{r}.json")):
+                problems.append(f"incident lacks rank_{r}.json")
+        mpath = os.path.join(inc, "gang.json")
+        if not os.path.exists(mpath):
+            problems.append("rank 0 wrote no gang.json manifest")
+        else:
+            manifest = json.load(open(mpath))
+            if not manifest.get("complete"):
+                problems.append(
+                    f"gang manifest not byte-verified: "
+                    f"{manifest.get('shards')}"
+                )
+    if problems:
+        print(f"[{app}] FAIL (gang telemetry): " + "; ".join(problems)
+              + f"\n--- rank 0 ---\n{outs[0]}\n--- rank 1 ---\n{outs[1]}")
+        return None
+    print(
+        f"[{app}] gang telemetry: merged trace complete "
+        f"({summary['events']} events, supersteps "
+        f"{summary['supersteps_by_rank']}, "
+        f"{summary['cross_rank_flows']} cross-rank flow(s)); "
+        f"incident {manifest.get('incident')} byte-verified across "
+        f"{manifest.get('nprocs')} rank(s)"
+    )
+    return {
+        "gang_trace_events": int(summary["events"]),
+        "gang_trace_complete": bool(summary["complete"]),
+        "gang_cross_rank_flows": int(summary["cross_rank_flows"]),
+        "gang_incident": str(manifest.get("incident", "")),
+        "gang_bundle_verified": bool(manifest.get("complete", False)),
+    }
+
+
 def kill_rank_drill(app: str, args, workdir: str) -> int:
     """Distributed resilience drill (docs/FAULT_TOLERANCE.md): a
     2-process gang runs the query at fnum 4 with sharded two-phase
@@ -449,6 +596,13 @@ def kill_rank_drill(app: str, args, workdir: str) -> int:
               f"{args.kill_at} (kill fires after commit)")
         return 1
 
+    # 2b. gang-wide telemetry leg (PR 20): the same gang, raise-mode
+    # kill — the halt travels the breach vote, so both ranks land
+    # their trace sidecars and postmortem shards under one incident
+    gang_fields = _gang_telemetry_leg(app, args, wd, common)
+    if gang_fields is None:
+        return 1
+
     # 3. reshard restore: single survivor process resumes the 4-shard
     # snapshot onto fnum 2
     out_res = os.path.join(wd, "out_res")
@@ -477,6 +631,7 @@ def kill_rank_drill(app: str, args, workdir: str) -> int:
             "checkpoint_rounds": int(meta["rounds"]),
             "restore_wall_s": round(wall, 3),
             "byte_identical": not problems,
+            **gang_fields,
         },
     }
     print(json.dumps(rec))

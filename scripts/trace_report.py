@@ -40,7 +40,18 @@ GRAPE_TRACE / --trace / obs.configure and prints:
   mixed-tenant fleet trace reads as one table;
 * a phase rollup (obs.rollup) for the non-superstep spans.
 
+With ``--gang`` (PR 20, obs/gang.py) TRACE names a gang sidecar
+directory — or the per-rank trace base whose ``<base>.gang`` dir
+holds the ``rank_<r>.json`` sidecars — and the report first merges
+every rank into ONE Perfetto timeline (one process track per rank,
+timestamps aligned onto rank 0's clock by the recorded handshake
+offsets, vote/2PC flow arrows preserved), prints the federation
+summary (per-rank span counts, flow coverage, completeness verdict),
+writes the merged trace next to the sidecars (or ``--out``), and then
+renders the usual tables over the merged stream.
+
 Usage: python scripts/trace_report.py TRACE [--drift-x 2.0]
+       python scripts/trace_report.py --gang TRACEDIR [--out merged.json]
 """
 
 from __future__ import annotations
@@ -352,8 +363,12 @@ def drift_flags(rows, drift_x: float):
         r["flag"] = ratio > drift_x or ratio < 1.0 / drift_x
 
 
-def render(events, drift_x: float = DRIFT_X, out=sys.stdout):
+def render(events, drift_x: float = DRIFT_X, out=None):
     from libgrape_lite_tpu.obs.export import rollup
+
+    # resolved at call time: a default bound at import would pin
+    # whatever stdout happened to be when the module first loaded
+    out = out if out is not None else sys.stdout
 
     rows = superstep_rows(events)
     attach_verdicts(rows, events)
@@ -473,15 +488,89 @@ def render(events, drift_x: float = DRIFT_X, out=sys.stdout):
     return flagged + pipe_flagged + pump_flagged
 
 
+def render_gang_summary(summary, out=None):
+    """The federation header of a --gang report: who contributed,
+    how the clocks were aligned, and whether the merge is complete
+    (every expected rank present, aligned, and span-bearing)."""
+    out = out if out is not None else sys.stdout
+    print("gang trace federation (obs/gang.py):", file=out)
+    print(
+        f"  ranks {summary['ranks']} of nprocs={summary['nprocs']}"
+        + (f", MISSING {summary['missing']}" if summary["missing"]
+           else ""),
+        file=out,
+    )
+    for r in sorted(summary["spans_by_rank"]):
+        print(
+            f"  rank {r}: {summary['spans_by_rank'][r]} span(s), "
+            f"{summary['supersteps_by_rank'].get(r, 0)} superstep(s)",
+            file=out,
+        )
+    print(
+        f"  flows: {summary['flow_ids']} id(s), "
+        f"{summary['flow_events']} leg event(s), "
+        f"{summary['cross_rank_flows']} crossing rank tracks",
+        file=out,
+    )
+    print(
+        f"  aligned={summary['aligned']} monotonic={summary['monotonic']} "
+        f"complete={summary['complete']}"
+        + (f"\n  merged trace -> {summary['out']}" if summary["out"]
+           else ""),
+        file=out,
+    )
+
+
+def _gang_dir_of(trace: str) -> str:
+    """Resolve the sidecar dir a --gang TRACE argument names: the dir
+    itself, or the `<base>.gang` twin of a per-rank trace path."""
+    if os.path.isdir(trace):
+        return trace
+    twin = trace + ".gang"
+    if os.path.isdir(twin):
+        return twin
+    base, _ = os.path.splitext(trace)
+    twin = base + ".gang"
+    if os.path.isdir(twin):
+        return twin
+    raise FileNotFoundError(
+        f"--gang: no sidecar dir at {trace!r} (or its .gang twin); "
+        "expected the dir GRAPE_TRACE's gang federation wrote "
+        "rank_<r>.json files into"
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="Chrome trace JSON or JSONL path")
+    ap.add_argument("trace", help="Chrome trace JSON or JSONL path "
+                                  "(with --gang: the sidecar dir or "
+                                  "the trace base of one)")
     ap.add_argument("--drift-x", type=float, default=DRIFT_X,
                     help="ratio-vs-median threshold to flag (default 2)")
+    ap.add_argument("--gang", action="store_true",
+                    help="merge every rank sidecar into one Perfetto "
+                         "timeline first, then render it")
+    ap.add_argument("--out", default="",
+                    help="with --gang: write the merged Chrome trace "
+                         "here (default <dir>/merged.json)")
     ns = ap.parse_args(argv)
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), ".."))
     from libgrape_lite_tpu.obs.export import load_trace
+
+    if ns.gang:
+        from libgrape_lite_tpu.obs import gang
+
+        dirpath = _gang_dir_of(ns.trace)
+        out_path = ns.out or os.path.join(dirpath, "merged.json")
+        summary = gang.assemble(dirpath, out_path=out_path)
+        render_gang_summary(summary)
+        if summary["events"]:
+            print(file=sys.stdout)
+            render(load_trace(out_path), ns.drift_x)
+        # an incomplete merge (missing rank, unaligned clock, or a
+        # span-less rank) is the federation's drift flag
+        return 0 if summary["complete"] else 1
 
     events = load_trace(ns.trace)
     render(events, ns.drift_x)

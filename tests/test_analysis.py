@@ -754,6 +754,84 @@ def test_r11_shipped_models_are_clean():
         assert not r11, (rel, [f.message for f in r11])
 
 
+# ---- R12: modeled overlap claims must carry a join key --------------------
+
+
+def test_r12_trips_on_unkeyed_literal():
+    src = """
+    def span_brief():
+        return {"engaged": True, "hidden_us_per_round": 12.5}
+    """
+    assert "R12" in _rules(src, "libgrape_lite_tpu/parallel/pipe.py")
+
+
+def test_r12_passes_with_plan_uid():
+    src = """
+    def span_brief():
+        return {
+            "engaged": True,
+            "hidden_us_per_round": 12.5,
+            "plan_uid": "gather:2:128",
+        }
+    """
+    assert "R12" not in _rules(src, "libgrape_lite_tpu/parallel/pipe.py")
+
+
+def test_r12_trips_on_decision_record_without_key():
+    # the pipeline.py idiom: a bound literal grown by subscript
+    # assignments — the union of keys must still carry the join key
+    src = """
+    def decide(plan):
+        dec = {"engaged": False}
+        dec["modeled_exchange_us"] = plan.cost()
+        return dec
+    """
+    assert "R12" in _rules(src, "libgrape_lite_tpu/parallel/pipe.py")
+
+
+def test_r12_passes_when_subscript_supplies_key():
+    src = """
+    def decide(plan):
+        dec = {"engaged": False}
+        dec["modeled_exchange_us"] = plan.cost()
+        dec["plan_uid"] = plan.uid
+        return dec
+    """
+    assert "R12" not in _rules(src, "libgrape_lite_tpu/parallel/pipe.py")
+
+
+def test_r12_accepts_trace_key_and_ignores_unengaged():
+    keyed = """
+    REC = {"engaged": True, "modeled_round_us": 3.0, "trace_key": "t"}
+    """
+    assert "R12" not in _rules(keyed, "libgrape_lite_tpu/models/m.py")
+    # a modeled_* dict that renders no `engaged` verdict is a cost
+    # table, not a decision record — out of scope
+    silent = """
+    COSTS = {"modeled_round_us": 3.0, "hidden_us_per_round": 1.0}
+    """
+    assert "R12" not in _rules(silent, "libgrape_lite_tpu/models/m.py")
+
+
+def test_r12_shipped_decision_records_are_keyed():
+    # zero-entry baseline over the live producers of modeled claims
+    import os
+
+    import libgrape_lite_tpu
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(libgrape_lite_tpu.__file__)))
+    for rel in (
+        "libgrape_lite_tpu/parallel/pipeline.py",
+        "libgrape_lite_tpu/models/vc2d.py",
+        "libgrape_lite_tpu/worker/worker.py",
+    ):
+        with open(os.path.join(root, rel)) as fh:
+            src = fh.read()
+        r12 = [f for f in lint_source(src, rel) if f.rule == "R12"]
+        assert not r12, (rel, [f.message for f in r12])
+
+
 # ---- baseline round-trip --------------------------------------------------
 
 

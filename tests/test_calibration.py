@@ -722,6 +722,12 @@ def _good_calibration_block():
         "fallback_notes": ["const+vpu_ops+mxu_ops: x"],
         "surfaces": {"spmv": {"modeled_s": 0.1, "measured_s": 0.11,
                               "samples": 5, "drift_pct": 2.4}},
+        "overlap_truth": {
+            "queries": 0, "joined": 0, "plan_uid": "-",
+            "modeled_hidden_us_per_round": 0.0,
+            "measured_round_us": 0.0, "claim_frac": 0.0,
+            "compile_rounds_excluded": 0, "ok": True,
+        },
     }
 
 

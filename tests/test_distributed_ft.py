@@ -277,7 +277,8 @@ def test_vote_local_error_reraised_after_exchange():
     with pytest.raises(InvariantBreachError, match="dist went up"):
         vote.round_vote(7, err)
     # the verdict crossed the wire BEFORE the local raise: code 1 at 7
-    assert exchanged == [[1, 7]]
+    # (third word: the r20 trace-id rider, 0 with tracing disarmed)
+    assert exchanged == [[1, 7, 0]]
 
 
 def test_vote_round_skew_is_a_halt():

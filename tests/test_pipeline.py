@@ -622,6 +622,14 @@ def _bench_pipeline_block():
         "exchange_bytes": 4096, "boundary_vertices": 805,
         "interior_vertices": 219, "boundary_edges": 32521,
         "interior_edges": 247, "overlap_recount_mismatch": 0.0,
+        "plan_uid": "gather:2:128:0:xla:-",
+        "overlap_truth": {
+            "queries": 2, "joined": 1,
+            "plan_uid": "gather:2:128:0:xla:-",
+            "modeled_hidden_us_per_round": 12.5,
+            "measured_round_us": 180.0, "claim_frac": 0.07,
+            "compile_rounds_excluded": 1, "ok": True,
+        },
     }
 
 
